@@ -1,0 +1,47 @@
+"""Test harness: 8 virtual CPU devices in one process.
+
+The standard JAX fake-backend trick (SURVEY.md §4 "Multi-device without a
+cluster"): `--xla_force_host_platform_device_count=8` exposes 8 CPU "devices"
+so mesh collectives — the DDP-equivalence property and psum'd metrics — are
+testable in plain pytest with no TPU attached. Must run before jax imports.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The build environment's sitecustomize pre-imports jax (TPU plugin
+# registration), so the env vars above are too late for it — force the
+# platform through the live config as well, before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from tpu_dp.parallel import dist
+
+    return dist.data_mesh()
+
+
+@pytest.fixture(scope="session")
+def mesh1():
+    import jax
+
+    from tpu_dp.parallel import dist
+
+    return dist.data_mesh(devices=jax.devices()[:1])
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,80 @@
+"""Data pipeline tests: normalization parity, pipeline sharding, prefetch."""
+
+import jax
+import numpy as np
+
+from tpu_dp.data import ArrayDataset, DataPipeline, load_dataset
+from tpu_dp.data.cifar import make_synthetic, normalize
+from tpu_dp.parallel import dist
+
+
+def test_normalize_matches_reference_transform():
+    """ToTensor + Normalize(0.5, 0.5) == x/255*2-1 (`cifar_example.py:38-40`)."""
+    u8 = np.array([[0, 127, 255]], dtype=np.uint8)
+    out = normalize(u8)
+    np.testing.assert_allclose(out, [[-1.0, 127 / 255 * 2 - 1, 1.0]], atol=1e-6)
+
+
+def test_synthetic_is_deterministic_and_separable():
+    a = make_synthetic(100, 10, seed=5, name="s")
+    b = make_synthetic(100, 10, seed=5, name="s")
+    np.testing.assert_array_equal(a.images, b.images)
+    np.testing.assert_array_equal(a.labels, b.labels)
+    # Class templates differ: mean images of two classes are far apart.
+    m0 = a.images[a.labels == a.labels[0]].mean(axis=0)
+    other = a.labels[a.labels != a.labels[0]][0]
+    m1 = a.images[a.labels == other].mean(axis=0)
+    assert np.abs(m0.astype(np.float32) - m1.astype(np.float32)).mean() > 5
+
+
+def test_load_dataset_synthetic_fallback(tmp_path):
+    ds = load_dataset("cifar10", tmp_path, train=True, synthetic_num_examples=64)
+    assert ds.synthetic and len(ds) == 64 and ds.num_classes == 10
+    ds100 = load_dataset("cifar100", tmp_path, train=False,
+                         synthetic_num_examples=32)
+    assert ds100.num_classes == 100
+
+
+def test_pipeline_shapes_and_epoch(mesh8):
+    ds = make_synthetic(100, 10, seed=0, name="s")
+    pipe = DataPipeline(ds, batch_size=16, mesh=mesh8, seed=0, prefetch=2)
+    assert len(pipe) == 6  # 100 // 16 with drop_remainder
+    batches = list(pipe)
+    assert len(batches) == 6
+    for b in batches:
+        assert b["image"].shape == (16, 32, 32, 3)
+        assert b["label"].shape == (16,)
+        assert b["image"].dtype == np.float32
+        # Sharded over the data axis of the mesh.
+        assert b["image"].sharding.spec[0] == dist.DATA_AXIS
+
+    pipe.set_epoch(0)
+    first = next(iter(pipe))
+    pipe.set_epoch(1)
+    second = next(iter(pipe))
+    assert not np.allclose(np.asarray(first["image"]), np.asarray(second["image"]))
+
+
+def test_pipeline_no_prefetch_matches_prefetch(mesh8):
+    ds = make_synthetic(64, 10, seed=2, name="s")
+    p0 = DataPipeline(ds, 16, mesh8, shuffle=False, prefetch=0)
+    p2 = DataPipeline(ds, 16, mesh8, shuffle=False, prefetch=2)
+    for a, b in zip(p0, p2):
+        np.testing.assert_array_equal(np.asarray(a["image"]), np.asarray(b["image"]))
+        np.testing.assert_array_equal(np.asarray(a["label"]), np.asarray(b["label"]))
+
+
+def test_cifar10_pickle_format_roundtrip(tmp_path):
+    """Write the standard CIFAR-10 batch layout and load it back."""
+    import pickle
+
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(1, 6):
+        data = rng.integers(0, 256, size=(20, 3072), dtype=np.int64).astype(np.uint8)
+        with open(d / f"data_batch_{i}", "wb") as f:
+            pickle.dump({b"data": data, b"labels": list(rng.integers(0, 10, 20))}, f)
+    ds = load_dataset("cifar10", tmp_path, train=True)
+    assert not ds.synthetic
+    assert ds.images.shape == (100, 32, 32, 3)

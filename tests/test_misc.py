@@ -1,0 +1,104 @@
+"""Metrics, checkpoint round-trip, config system, dist helpers."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_dp import checkpoint as ckpt
+from tpu_dp.config import Config, PRESETS, parse_cli
+from tpu_dp.metrics import Accuracy, Mean
+from tpu_dp.models import Net
+from tpu_dp.parallel import dist
+from tpu_dp.train import SGD, create_train_state
+
+
+def test_accuracy_and_mean():
+    acc = Accuracy()
+    acc.update(3, 4)
+    acc.update(1, 4)
+    assert acc.compute() == pytest.approx(0.5)
+    m = Mean()
+    m.update(2.0, 3)
+    m.update(5.0, 1)
+    assert m.compute() == pytest.approx((6.0 + 5.0) / 4)
+    # Weighted mean fixes the reference's ÷2000-regardless-of-remainder
+    # quirk (`cifar_example.py:86`).
+    acc.reset(); m.reset()
+    assert acc.compute() == 0.0 and m.compute() == 0.0
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    """Save → restore closes the reference's save-only gap (SURVEY.md §5)."""
+    model, opt = Net(), SGD(0.9)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32), opt
+    )
+    state = state.replace(step=state.step + 7)
+    path = ckpt.save_checkpoint(tmp_path / "ck", state, {"epoch": 3})
+    assert path is not None and ckpt.checkpoint_exists(tmp_path / "ck")
+
+    fresh = create_train_state(
+        model, jax.random.PRNGKey(1), np.zeros((1, 32, 32, 3), np.float32), opt
+    )
+    restored, meta = ckpt.load_checkpoint(tmp_path / "ck", fresh)
+    assert meta["epoch"] == 3
+    assert int(restored.step) == 7
+    for a, b in zip(
+        jax.tree_util.tree_leaves(restored.params),
+        jax.tree_util.tree_leaves(state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_params_export_roundtrip(tmp_path):
+    model = Net()
+    v = model.init(jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32))
+    p = ckpt.save_params(tmp_path / "w.msgpack", v["params"])
+    assert p is not None
+    loaded = ckpt.load_params(p, v["params"])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(loaded), jax.tree_util.tree_leaves(v["params"])
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_config_defaults_are_reference_values():
+    c = Config()
+    assert c.data.batch_size == 4  # `cifar_example.py:42`
+    assert c.optim.lr == 0.001 and c.optim.momentum == 0.9  # `:64`
+    assert c.train.epochs == 2  # `:66`
+    assert c.train.log_every == 2000  # `:84`
+
+
+def test_config_overrides_and_presets():
+    c = parse_cli(["--preset=resnet18_8chip_gb1024", "--train.epochs=3",
+                   "--model.bf16=true", "--optim.lr=0.5"])
+    assert c.model.name == "resnet18"
+    assert c.data.batch_size == 1024
+    assert c.train.epochs == 3 and c.model.bf16 and c.optim.lr == 0.5
+    assert set(PRESETS) == {
+        "reference", "resnet18_cifar10", "resnet50_cifar100",
+        "resnet18_8chip_gb1024", "bf16_cosine_gb4096",
+    }
+    with pytest.raises(ValueError):
+        Config().override("optim.nonexistent", "1")
+
+
+def test_dist_context_and_barrier(mesh8):
+    ctx = dist.initialize()
+    assert ctx.process_count == 1 and ctx.is_main_process
+    assert dist.device_count() == 8
+    assert mesh8.shape[dist.DATA_AXIS] == 8
+    dist.barrier(mesh8)  # completes without deadlock/error
+
+
+def test_schedule_shapes():
+    from tpu_dp.train import cosine_lr, make_schedule
+
+    s = cosine_lr(1.0, total_steps=100, warmup_steps=10)
+    assert float(s(0)) == pytest.approx(0.0)
+    assert float(s(10)) == pytest.approx(1.0, abs=1e-6)
+    assert float(s(100)) == pytest.approx(0.0, abs=1e-6)
+    assert float(s(55)) == pytest.approx(0.5, abs=0.01)
+    with pytest.raises(ValueError):
+        make_schedule("nope", 0.1)

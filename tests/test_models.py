@@ -1,0 +1,70 @@
+"""Model unit tests — shapes and parameter counts vs the reference spec.
+
+SURVEY.md §4 Unit: "model forward shapes/param counts vs `Net` spec
+(`cifar_example.py:20-25`: conv 3→6→16, fc 400→120→84→10)".
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dp.models import Net, ResNet18, ResNet50, build_model
+
+
+def _param_count(params):
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def test_net_output_shape_and_param_count():
+    model = Net()
+    x = np.zeros((4, 32, 32, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    logits = model.apply(variables, x)
+    assert logits.shape == (4, 10)
+    # Exact torch parity: conv1 456 + conv2 2416 + fc1 48120 + fc2 10164
+    # + fc3 850 = 62006 (`cifar_example.py:20-25`).
+    assert _param_count(variables["params"]) == 62_006
+
+
+def test_net_layer_shapes():
+    model = Net()
+    x = np.zeros((2, 32, 32, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    p = variables["params"]
+    assert p["conv1"]["kernel"].shape == (5, 5, 3, 6)
+    assert p["conv2"]["kernel"].shape == (5, 5, 6, 16)
+    assert p["fc1"]["kernel"].shape == (400, 120)  # 16·5·5 = 400
+    assert p["fc2"]["kernel"].shape == (120, 84)
+    assert p["fc3"]["kernel"].shape == (84, 10)
+
+
+@pytest.mark.parametrize("factory,expected_min", [(ResNet18, 11e6)])
+def test_resnet18_forward(factory, expected_min):
+    model = factory(num_classes=10)
+    x = np.zeros((2, 32, 32, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    # CIFAR ResNet-18 ≈ 11.17M params.
+    n = _param_count(variables["params"])
+    assert expected_min < n < 12e6
+    assert "batch_stats" in variables
+
+
+def test_resnet50_builds():
+    model = build_model("resnet50", num_classes=100)
+    x = np.zeros((1, 32, 32, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (1, 100)
+
+
+def test_net_bf16_compute():
+    model = Net(dtype=jnp.bfloat16)
+    x = np.zeros((2, 32, 32, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    # Params stay f32; logits come back f32 (final dense computes in f32).
+    kinds = {x.dtype for x in jax.tree_util.tree_leaves(variables["params"])}
+    assert kinds == {np.dtype(np.float32)}
+    assert model.apply(variables, x).dtype == jnp.float32

@@ -1,0 +1,118 @@
+"""Compiled-step tests — the DDP-equivalence property and training dynamics.
+
+SURVEY.md §4: "N-device grads == single-device grads on the concatenated
+batch" is *the* correctness property of gradient-averaging data parallelism
+(what DDP's allreduce guarantees, `cifar_example_ddp.py:83`), and loss
+decrease is the reference's only in-band training signal
+(`cifar_example.py:84-87`).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_dp.data.cifar import make_synthetic, normalize
+from tpu_dp.models import Net
+from tpu_dp.train import (
+    SGD,
+    constant_lr,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+
+
+def _make_batch(rng, n):
+    ds = make_synthetic(n, 10, seed=0, name="synthetic")
+    return {"image": normalize(ds.images), "label": ds.labels}
+
+
+def _copy(state):
+    # The train step donates its input state; tests that reuse a state
+    # across two step functions must pass fresh buffers.
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(jnp.array, state)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = Net()
+    opt = SGD(momentum=0.9)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32), opt
+    )
+    return model, opt, state
+
+
+def test_dp_equivalence_8_vs_1(setup, mesh8, mesh1, rng):
+    """Same global batch ⇒ same updated params on a 1-mesh and an 8-mesh."""
+    model, opt, state = setup
+    batch = _make_batch(rng, 16)
+
+    step8 = make_train_step(model, opt, mesh8, constant_lr(0.01))
+    step1 = make_train_step(model, opt, mesh1, constant_lr(0.01))
+
+    s8, m8 = step8(_copy(state), batch)
+    s1, m1 = step1(_copy(state), batch)
+
+    np.testing.assert_allclose(float(m8["loss"]), float(m1["loss"]), rtol=1e-5)
+    assert int(m8["correct"]) == int(m1["correct"])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s8.params), jax.tree_util.tree_leaves(s1.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_multi_step_trajectory_equivalence(setup, mesh8, mesh1, rng):
+    """Replicas stay in lockstep over several steps (momentum included)."""
+    model, opt, state = setup
+    step8 = make_train_step(model, opt, mesh8, constant_lr(0.05))
+    step1 = make_train_step(model, opt, mesh1, constant_lr(0.05))
+    s8, s1 = _copy(state), _copy(state)
+    for i in range(3):
+        batch = _make_batch(np.random.default_rng(i), 8)
+        s8, _ = step8(s8, batch)
+        s1, _ = step1(s1, batch)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s8.params), jax.tree_util.tree_leaves(s1.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_loss_decreases(setup, mesh8, rng):
+    """The reference's in-band signal: running loss goes down."""
+    model, opt, state = setup
+    step = make_train_step(model, opt, mesh8, constant_lr(0.05))
+    state = _copy(state)
+    ds = make_synthetic(512, 10, seed=1, name="synthetic")
+    losses = []
+    for i in range(20):
+        sel = slice((i * 64) % 512, (i * 64) % 512 + 64)
+        batch = {
+            "image": normalize(ds.images[sel]),
+            "label": ds.labels[sel],
+        }
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.05
+
+
+def test_step_counter_and_lr(setup, mesh8, rng):
+    model, opt, state = setup
+    step = make_train_step(model, opt, mesh8, constant_lr(0.01))
+    batch = _make_batch(rng, 8)
+    state = _copy(state)
+    prev_step = int(state.step)
+    s1, m = step(state, batch)
+    assert int(s1.step) == prev_step + 1
+    assert float(m["lr"]) == pytest.approx(0.01)
+
+
+def test_eval_step_counts(setup, mesh8, rng):
+    model, opt, state = setup
+    ev = make_eval_step(model, mesh8)
+    batch = _make_batch(rng, 24)
+    m = ev(state, batch)
+    assert int(m["count"]) == 24
+    assert 0 <= int(m["correct"]) <= 24

@@ -1,0 +1,37 @@
+"""tpu_dp — a TPU-native data-parallel training framework.
+
+Brand-new framework with the capabilities of the rensortino/DDP-Tutorial
+reference (a PyTorch DistributedDataParallel CIFAR-10 tutorial), re-designed
+TPU-first: one jitted train step over a named JAX device mesh in which the
+gradient all-reduce over ICI is part of the compiled program, a host-sharded
+epoch-seeded input pipeline, Flax models, psum-synced eval metrics, pytree
+checkpointing with resume, and `jax.distributed.initialize` bootstrap in place
+of a launcher. Single-chip and N-chip runs are the same code path with
+different mesh shapes — erasing the single/DDP script fork that structures the
+reference (`/root/reference/cifar_example.py` vs `cifar_example_ddp.py`).
+"""
+
+from tpu_dp import config, data, metrics, models, ops, parallel, train, utils
+from tpu_dp.checkpoint import load_checkpoint, save_checkpoint
+from tpu_dp.config import Config
+from tpu_dp.parallel import dist
+from tpu_dp.train.state import TrainState
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Config",
+    "TrainState",
+    "checkpoint",
+    "config",
+    "data",
+    "dist",
+    "load_checkpoint",
+    "metrics",
+    "models",
+    "ops",
+    "parallel",
+    "save_checkpoint",
+    "train",
+    "utils",
+]

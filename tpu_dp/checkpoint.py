@@ -1,0 +1,89 @@
+"""Checkpoint save/restore — closing the reference's save-only gap.
+
+The reference only ever saves: `torch.save(net.state_dict(), './cifar_net.pth')`
+at end of training (`/root/reference/cifar_example.py:92-93`), from *every*
+rank to the same path (last-writer-wins race), with DDP's `module.` key
+prefix, and with no load/resume path, no optimizer state, no epoch counter
+(SURVEY.md §5 "Checkpoint / resume — SAVE ONLY"). Here:
+
+- the checkpoint is the full `TrainState` pytree (params + momentum buffers +
+  batch stats + step) plus host metadata (epoch, sampler seed, config), so a
+  run restores bit-exactly where it left off;
+- only process 0 writes (others pass through), and the write is
+  atomic (tmp file + rename) — no cross-rank or crash torn-write races;
+- serialization is flax msgpack of numpy-ified arrays — no pickle of live
+  objects, no `module.` prefix artifact;
+- a final-weights export (`save_params`) matches the reference's
+  end-of-training `state_dict` save semantics for inference handoff.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+from flax import serialization
+
+from tpu_dp.train.state import TrainState
+
+_CKPT_NAME = "state.msgpack"
+_META_NAME = "meta.json"
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def save_checkpoint(
+    ckpt_dir: str | os.PathLike,
+    state: TrainState,
+    meta: dict[str, Any] | None = None,
+) -> Path | None:
+    """Write state + metadata; process 0 only. Returns the path (rank 0)."""
+    ckpt_dir = Path(ckpt_dir)
+    if jax.process_index() != 0:
+        return None
+    ckpt_dir.mkdir(parents=True, exist_ok=True)
+    payload = serialization.to_bytes(_to_host(state))
+    tmp = ckpt_dir / (_CKPT_NAME + ".tmp")
+    tmp.write_bytes(payload)
+    os.replace(tmp, ckpt_dir / _CKPT_NAME)
+    meta_tmp = ckpt_dir / (_META_NAME + ".tmp")
+    meta_tmp.write_text(json.dumps(meta or {}, indent=2, default=str))
+    os.replace(meta_tmp, ckpt_dir / _META_NAME)
+    return ckpt_dir / _CKPT_NAME
+
+
+def load_checkpoint(
+    ckpt_dir: str | os.PathLike, target: TrainState
+) -> tuple[TrainState, dict[str, Any]]:
+    """Restore a `TrainState` (shaped like `target`) + metadata."""
+    ckpt_dir = Path(ckpt_dir)
+    payload = (ckpt_dir / _CKPT_NAME).read_bytes()
+    state = serialization.from_bytes(_to_host(target), payload)
+    meta_path = ckpt_dir / _META_NAME
+    meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
+    return state, meta
+
+
+def checkpoint_exists(ckpt_dir: str | os.PathLike) -> bool:
+    return (Path(ckpt_dir) / _CKPT_NAME).exists()
+
+
+def save_params(path: str | os.PathLike, params) -> Path | None:
+    """Final-weights export — `torch.save(state_dict)` analogue
+    (`cifar_example.py:92-93`), written once by process 0, clean key names."""
+    if jax.process_index() != 0:
+        return None
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_bytes(serialization.to_bytes(_to_host(params)))
+    return path
+
+
+def load_params(path: str | os.PathLike, target):
+    return serialization.from_bytes(_to_host(target), Path(path).read_bytes())

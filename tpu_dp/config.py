@@ -1,0 +1,182 @@
+"""Dataclass config system with CLI overrides and named presets.
+
+The reference's "config system" is one dead argparse flag (`--world_size`,
+overwritten from env — `/root/reference/cifar_example_ddp.py:139-144,44`) and
+hardcoded hyperparameters: batch_size=4, lr=0.001/momentum=0.9, epochs=2,
+normalize=0.5, ckpt path `./cifar_net.pth`, rendezvous `127.0.0.1:29500`
+(SURVEY.md §5 "Config"). Here those hardcoded values are the *defaults* of a
+structured config, and BASELINE.json's five target configs are presets, not
+code forks. Override syntax: ``--section.field=value`` on any entry script.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+
+@dataclass
+class ModelConfig:
+    name: str = "net"  # net | resnet18 | resnet50
+    num_classes: int | None = None  # None = derive from dataset; set = must agree
+    bf16: bool = False  # compute dtype bfloat16 (params stay f32)
+
+
+@dataclass
+class DataConfig:
+    dataset: str = "cifar10"  # cifar10 | cifar100 | synthetic
+    root: str = "./data"  # reference's `./data` (`cifar_example.py:44`)
+    batch_size: int = 4  # per-process; reference parity (`cifar_example.py:42`)
+    shuffle: bool = True
+    drop_remainder: bool = True
+    prefetch: int = 2  # replaces num_workers=2 (`cifar_example.py:47`)
+    synthetic_train_size: int | None = None
+    synthetic_test_size: int | None = None
+    allow_synthetic: bool = True
+
+
+@dataclass
+class OptimConfig:
+    lr: float = 0.001  # `cifar_example.py:64`
+    momentum: float = 0.9  # `cifar_example.py:64`
+    weight_decay: float = 0.0
+    schedule: str = "constant"  # constant | cosine
+    warmup_epochs: float = 0.0
+    final_lr: float = 0.0
+
+
+@dataclass
+class TrainConfig:
+    epochs: int = 2  # `cifar_example.py:66`
+    log_every: int = 2000  # `cifar_example.py:84`
+    seed: int = 0
+    eval_at_end: bool = True
+    ckpt_dir: str = "./checkpoints"
+    resume: bool = False
+    profile_dir: str | None = None  # enable jax.profiler traces when set
+
+
+@dataclass
+class ParallelConfig:
+    num_devices: int | None = None  # None = all visible devices
+    coordinator_address: str | None = None
+    num_processes: int | None = None
+    process_id: int | None = None
+
+
+@dataclass
+class Config:
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+
+    def override(self, dotted: str, value: str) -> None:
+        """Apply one ``section.field=value`` override, coercing to field type."""
+        section_name, _, field_name = dotted.partition(".")
+        if not field_name:
+            raise ValueError(f"override {dotted!r} must be section.field")
+        section = getattr(self, section_name)
+        if not hasattr(section, field_name):
+            raise ValueError(f"no field {field_name!r} in {section_name}")
+        current = getattr(section, field_name)
+        setattr(section, field_name, _coerce(value, current))
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _coerce(value: str, current: Any):
+    if isinstance(current, bool):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(current, int):
+        return int(value)
+    if isinstance(current, float):
+        return float(value)
+    if current is None:
+        for cast in (int, float):
+            try:
+                return cast(value)
+            except ValueError:
+                pass
+        return None if value.lower() in ("none", "null") else value
+    return value
+
+
+# BASELINE.json's five target configs as presets (SURVEY.md §6).
+def _preset_reference_single() -> Config:
+    """Config 1 analogue + exact reference parity: `Net`, batch 4, 2 epochs."""
+    return Config()
+
+
+def _preset_resnet18_cifar10() -> Config:
+    """Config 1/2: ResNet-18 on CIFAR-10 (mesh size sets the parallelism)."""
+    c = Config()
+    c.model = ModelConfig(name="resnet18", num_classes=10)
+    c.data.batch_size = 128
+    c.optim = OptimConfig(lr=0.1, momentum=0.9, weight_decay=5e-4,
+                          schedule="cosine", warmup_epochs=1.0)
+    c.train.epochs = 30
+    return c
+
+
+def _preset_resnet50_cifar100() -> Config:
+    """Config 3: ResNet-50 on CIFAR-100."""
+    c = _preset_resnet18_cifar10()
+    c.model = ModelConfig(name="resnet50", num_classes=100)
+    c.data.dataset = "cifar100"
+    return c
+
+
+def _preset_resnet18_8chip_gb1024() -> Config:
+    """Config 4: 8-chip DP ResNet-18, global batch 1024."""
+    c = _preset_resnet18_cifar10()
+    c.data.batch_size = 1024  # global; sharded 128/chip over an 8-chip mesh
+    c.optim.lr = 0.4  # linear-scaling rule vs batch-128 base 0.05/...
+    c.optim.warmup_epochs = 5.0
+    c.train.epochs = 50
+    return c
+
+
+def _preset_bf16_cosine_gb4096() -> Config:
+    """Config 5: bf16 mixed precision + cosine LR, global batch 4096."""
+    c = _preset_resnet18_8chip_gb1024()
+    c.model.bf16 = True
+    c.data.batch_size = 4096
+    c.optim.lr = 1.6
+    c.optim.warmup_epochs = 10.0
+    c.train.epochs = 60
+    return c
+
+
+PRESETS = {
+    "reference": _preset_reference_single,
+    "resnet18_cifar10": _preset_resnet18_cifar10,
+    "resnet50_cifar100": _preset_resnet50_cifar100,
+    "resnet18_8chip_gb1024": _preset_resnet18_8chip_gb1024,
+    "bf16_cosine_gb4096": _preset_bf16_cosine_gb4096,
+}
+
+
+def parse_cli(argv: Sequence[str]) -> Config:
+    """`--preset=name` then any number of `--section.field=value` overrides."""
+    cfg: Config | None = None
+    overrides: list[tuple[str, str]] = []
+    for arg in argv:
+        if not arg.startswith("--"):
+            raise ValueError(f"unexpected argument {arg!r}")
+        key, _, value = arg[2:].partition("=")
+        if key == "preset":
+            if value not in PRESETS:
+                raise ValueError(
+                    f"unknown preset {value!r}; available: {sorted(PRESETS)}"
+                )
+            cfg = PRESETS[value]()
+        else:
+            overrides.append((key, value))
+    cfg = cfg or Config()
+    for key, value in overrides:
+        cfg.override(key, value)
+    return cfg

@@ -1,0 +1,10 @@
+"""Cross-replica-exact metrics.
+
+Parity layer for `torchmetrics.Accuracy(dist_sync_on_step=True)`
+(`/root/reference/cifar_example_ddp.py:124,133,136`) and the running-loss
+meter (`cifar_example.py:83-87`).
+"""
+
+from tpu_dp.metrics.metrics import Accuracy, Mean
+
+__all__ = ["Accuracy", "Mean"]

@@ -1,0 +1,57 @@
+"""Accuracy and Mean metric accumulators with exact global semantics.
+
+The reference's distributed metric is
+`torchmetrics.Accuracy(dist_sync_on_step=True)`
+(`/root/reference/cifar_example_ddp.py:124`): every `.update()` all-reduces
+correct/total counts across ranks and `.compute()` yields the global top-1
+(SURVEY.md §3.4 — and notes the per-step sync is wasteful by design).
+
+TPU-native: the compiled train/eval steps already return *globally exact*
+(correct, count) scalars — the cross-chip reduction over the sharded batch is
+part of the XLA program — so the host-side accumulator below just sums
+Python/NumPy scalars. That gives `dist_sync_on_step=True` accuracy semantics
+with zero extra collectives per step, and exact weighted loss means (fixing
+the reference's running-loss ÷2000-regardless-of-remainder quirk,
+`cifar_example.py:86`, SURVEY.md §2A quirks — the parity-print path
+reproduces the reference's formatting separately in the Trainer).
+"""
+
+from __future__ import annotations
+
+
+class Accuracy:
+    """Global top-1 accuracy from per-step (correct, count) scalars."""
+
+    def __init__(self):
+        self.correct = 0
+        self.count = 0
+
+    def update(self, correct, count) -> None:
+        self.correct += int(correct)
+        self.count += int(count)
+
+    def compute(self) -> float:
+        return self.correct / max(1, self.count)
+
+    def reset(self) -> None:
+        self.correct = 0
+        self.count = 0
+
+
+class Mean:
+    """Weighted running mean (e.g. loss over examples)."""
+
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+
+    def update(self, value, weight=1) -> None:
+        self.total += float(value) * int(weight)
+        self.count += int(weight)
+
+    def compute(self) -> float:
+        return self.total / max(1, self.count)
+
+    def reset(self) -> None:
+        self.total = 0.0
+        self.count = 0

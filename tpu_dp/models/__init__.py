@@ -1,0 +1,30 @@
+"""Model zoo: the reference's `Net` (behavioral parity) and CIFAR ResNets.
+
+The reference defines one model, a LeNet-style CNN
+(`/root/reference/cifar_example.py:17-34`), which cannot reach the 93% top-1
+north-star; BASELINE.json's configs name ResNet-18/50, so the zoo carries
+both (SURVEY.md §6 note).
+"""
+
+from tpu_dp.models.net import Net
+from tpu_dp.models.resnet import ResNet, ResNet18, ResNet50
+
+_REGISTRY = {
+    "net": lambda num_classes=10, **kw: Net(num_classes=num_classes, **kw),
+    "resnet18": lambda num_classes=10, **kw: ResNet18(num_classes=num_classes, **kw),
+    "resnet50": lambda num_classes=10, **kw: ResNet50(num_classes=num_classes, **kw),
+}
+
+
+def build_model(name: str, num_classes: int = 10, **kwargs):
+    """Construct a model by config name (`tpu_dp.config.ModelConfig.name`)."""
+    try:
+        factory = _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+    return factory(num_classes=num_classes, **kwargs)
+
+
+__all__ = ["Net", "ResNet", "ResNet18", "ResNet50", "build_model"]

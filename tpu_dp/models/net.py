@@ -1,0 +1,46 @@
+"""`Net` — the reference's LeNet-style CIFAR CNN, as a Flax module.
+
+Topology parity with `/root/reference/cifar_example.py:17-34` (duplicated at
+`cifar_example_ddp.py:23-40`):
+
+    conv1: 3→6, 5×5, valid padding        (456 params)
+    maxpool 2×2 stride 2
+    conv2: 6→16, 5×5, valid padding       (2 416 params)
+    maxpool 2×2 stride 2
+    flatten → fc1: 400→120 (48 120) → fc2: 120→84 (10 164) → fc3: 84→10 (850)
+
+Total 62 006 parameters, matching torch's `Net` exactly. Layout is NHWC
+(TPU-native; the reference's NCHW is a CUDA/cuDNN convention) and the flatten
+order is therefore H·W·C rather than torch's C·H·W — weight-level parity
+would need a permutation, documented here as the one intentional divergence.
+ReLU after each conv and after fc1/fc2; logits (no softmax) from fc3, matching
+`CrossEntropyLoss` taking raw logits (`cifar_example.py:63`).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Net(nn.Module):
+    """The reference CNN (`cifar_example.py:17-34`), NHWC, Flax."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train  # no dropout/batchnorm, matching the reference
+        x = x.astype(self.dtype)
+        x = nn.Conv(6, (5, 5), padding="VALID", dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype, name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))  # flatten all dims except batch
+        x = nn.relu(nn.Dense(120, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype, name="fc2")(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc3")(x)
+        return x

@@ -1,0 +1,133 @@
+"""CIFAR-variant ResNet-18/50 in Flax — the north-star models.
+
+The reference has no ResNet (its only model is the LeNet-style `Net`,
+`/root/reference/cifar_example.py:17-34`), but BASELINE.json's target configs
+name "ResNet-18" and "ResNet-50 on CIFAR-100", so these are first-class
+(SURVEY.md §6). CIFAR variant: 3×3 stride-1 stem, no stem max-pool (32×32
+inputs would collapse under the ImageNet 7×7/s2 + pool stem), stages
+[64, 128, 256, 512] with stride 2 from stage 2 on.
+
+TPU-first notes:
+- NHWC layout; convs and the final dense land on the MXU as large batched
+  contractions.
+- `dtype` (compute dtype) can be bfloat16 for mixed precision while parameters
+  and batch-norm statistics stay float32 — BASELINE.json config 5.
+- BatchNorm batch statistics are computed over the *global* (logical) batch:
+  under `jit` with the batch sharded on the ``data`` mesh axis, GSPMD turns
+  the mean/var reductions into cross-chip all-reduces automatically — i.e.
+  sync-BN semantics fall out of the sharded program rather than needing a
+  wrapper. `axis_name` is plumbed for the explicit `shard_map` path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """Two 3×3 convs + identity/projection shortcut (ResNet-18/34)."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), strides=(self.strides, self.strides),
+                name="shortcut_conv",
+            )(residual)
+            residual = self.norm(name="shortcut_norm")(residual)
+        return self.act(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1×1 reduce → 3×3 → 1×1 expand (×4) bottleneck (ResNet-50+)."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                name="shortcut_conv",
+            )(residual)
+            residual = self.norm(name="shortcut_norm")(residual)
+        return self.act(y + residual)
+
+
+class ResNet(nn.Module):
+    """CIFAR-variant ResNet over NHWC inputs."""
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 10
+    num_filters: int = 64
+    dtype: jnp.dtype = jnp.float32
+    axis_name: str | None = None  # set when used inside shard_map/pmap
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            axis_name=self.axis_name,
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (3, 3), name="stem_conv")(x)
+        x = norm(name="stem_norm")(x)
+        x = nn.relu(x)
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="classifier")(x)
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock)

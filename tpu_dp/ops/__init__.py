@@ -1,0 +1,14 @@
+"""Ops layer: native (C++) host-side runtime pieces and Pallas TPU kernels.
+
+The reference's native machinery all lives in libraries below its Python
+surface — NCCL collectives, cuDNN kernels, the DDP C++ reducer (SURVEY.md
+§2B). Here the TPU compute path is XLA-lowered (convs/matmuls hit the MXU
+without hand-written kernels; Pallas kernels where XLA underperforms), and
+the host-side runtime pieces — topology introspection and a Gloo-style CPU
+ring allreduce fallback for host coordination off-TPU — are native C++
+(`tpu_dp/ops/native/`), bound via ctypes.
+"""
+
+from tpu_dp.ops import native
+
+__all__ = ["native"]

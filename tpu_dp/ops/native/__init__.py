@@ -1,0 +1,34 @@
+"""ctypes bindings to the native (C++) host runtime library.
+
+Built lazily from `hostring.cpp` with g++ into `libtpudp_host.so` (cached
+next to the source). Provides:
+
+- topology introspection (`cpu_count`, `hostname`) — the host-side analogue
+  of the reference's device pinning info (`torch.cuda.set_device`,
+  `/root/reference/cifar_example_ddp.py:53`);
+- a TCP ring allreduce + barrier across processes — a Gloo-style fallback
+  backing host-level collective semantics when no XLA mesh is available
+  (parity with the reference's NCCL layer per SURVEY.md §2B row 1; the TPU
+  path stays XLA-lowered and never uses this).
+
+If the toolchain is unavailable the import still succeeds; `available()`
+returns False and pure-Python fallbacks are used.
+"""
+
+from tpu_dp.ops.native.hostlib import (
+    Ring,
+    available,
+    cpu_count,
+    hostname,
+    ring_allreduce,
+    ring_barrier,
+)
+
+__all__ = [
+    "Ring",
+    "available",
+    "cpu_count",
+    "hostname",
+    "ring_allreduce",
+    "ring_barrier",
+]

@@ -1,0 +1,156 @@
+"""Lazy g++ build + ctypes bindings for the native host library."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket as _socket
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_SRC = Path(__file__).with_name("hostring.cpp")
+_LIB = Path(__file__).with_name("libtpudp_host.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_failed = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+        str(_SRC), "-o", str(_LIB),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return False
+
+
+def _get() -> ctypes.CDLL | None:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            if not _build():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(str(_LIB))
+        except OSError:
+            _build_failed = True
+            return None
+        lib.tpudp_cpu_count.restype = ctypes.c_int
+        lib.tpudp_hostname.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tpudp_hostname.restype = ctypes.c_int
+        lib.tpudp_ring_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.tpudp_ring_create.restype = ctypes.c_void_p
+        lib.tpudp_ring_allreduce.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.c_int,
+        ]
+        lib.tpudp_ring_allreduce.restype = ctypes.c_int
+        lib.tpudp_ring_barrier.argtypes = [ctypes.c_void_p]
+        lib.tpudp_ring_barrier.restype = ctypes.c_int
+        lib.tpudp_ring_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the C++ library built/loaded successfully."""
+    return _get() is not None
+
+
+def cpu_count() -> int:
+    lib = _get()
+    if lib is not None:
+        n = lib.tpudp_cpu_count()
+        if n > 0:
+            return n
+    return os.cpu_count() or 1
+
+
+def hostname() -> str:
+    lib = _get()
+    if lib is not None:
+        buf = ctypes.create_string_buffer(256)
+        if lib.tpudp_hostname(buf, 256) == 0:
+            return buf.value.decode()
+    return _socket.gethostname()
+
+
+class Ring:
+    """A TCP ring over `world` processes for host-side collectives.
+
+    The Gloo-style fallback for the collective layer (SURVEY.md §2B row 1);
+    semantically identical to the XLA path: allreduce(sum/mean) + barrier.
+    """
+
+    def __init__(self, host: str, base_port: int, rank: int, world: int,
+                 timeout_ms: int = 10_000):
+        lib = _get()
+        if lib is None:
+            raise RuntimeError("native host library unavailable (g++ build failed)")
+        self._lib = lib
+        self.rank = rank
+        self.world = world
+        self._ctx = lib.tpudp_ring_create(
+            host.encode(), base_port, rank, world, timeout_ms
+        )
+        if not self._ctx and world > 1:
+            raise RuntimeError(
+                f"ring rendezvous failed (rank {rank}/{world} @ {host}:{base_port})"
+            )
+
+    def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
+        """In-place float32 allreduce across the ring; returns the array."""
+        arr = np.ascontiguousarray(array, dtype=np.float32)
+        opc = {"sum": 0, "mean": 1}[op]
+        rc = self._lib.tpudp_ring_allreduce(
+            self._ctx,
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            arr.size,
+            opc,
+        )
+        if rc != 0:
+            raise RuntimeError("ring allreduce failed")
+        return arr
+
+    def barrier(self) -> None:
+        if self._lib.tpudp_ring_barrier(self._ctx) != 0:
+            raise RuntimeError("ring barrier failed")
+
+    def close(self) -> None:
+        if getattr(self, "_ctx", None):
+            self._lib.tpudp_ring_destroy(self._ctx)
+            self._ctx = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def ring_allreduce(ring: Ring, array: np.ndarray, op: str = "sum") -> np.ndarray:
+    return ring.allreduce(array, op)
+
+
+def ring_barrier(ring: Ring) -> None:
+    ring.barrier()

@@ -1,0 +1,44 @@
+"""Distributed runtime: process bootstrap, device mesh, collectives.
+
+TPU-native replacement for the reference's L1 layer — the NCCL process group
+(`/root/reference/cifar_example_ddp.py:42-58`): `init_process_group('nccl')`
+becomes `jax.distributed.initialize`, the `MASTER_ADDR:MASTER_PORT` TCPStore
+rendezvous becomes the JAX coordinator, `dist.barrier()` becomes a psum of a
+unit scalar over the mesh, and the DDP gradient-hook allreduce becomes a
+`pmean` (or GSPMD-inserted all-reduce) inside the compiled train step.
+"""
+
+from tpu_dp.parallel.dist import (
+    DistContext,
+    barrier,
+    data_mesh,
+    device_count,
+    initialize,
+    local_device_count,
+    process_count,
+    process_index,
+    shutdown,
+)
+from tpu_dp.parallel.collectives import pmean, psum
+from tpu_dp.parallel.sharding import (
+    batch_sharding,
+    replicated_sharding,
+    shard_batch,
+)
+
+__all__ = [
+    "DistContext",
+    "barrier",
+    "batch_sharding",
+    "data_mesh",
+    "device_count",
+    "initialize",
+    "local_device_count",
+    "pmean",
+    "process_count",
+    "process_index",
+    "psum",
+    "replicated_sharding",
+    "shard_batch",
+    "shutdown",
+]

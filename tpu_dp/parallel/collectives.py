@@ -1,0 +1,42 @@
+"""Collective helpers over the named mesh axis.
+
+The only collective *primitives* parity with the reference requires are
+allreduce(mean/sum) and barrier (SURVEY.md §5 "Distributed communication
+backend"): NCCL allreduce-mean backs DDP's gradient hooks
+(`cifar_example_ddp.py:83`) and allreduce-sum backs torchmetrics' state sync
+(`cifar_example_ddp.py:124`). On TPU these lower to XLA all-reduces over ICI;
+inside `shard_map` they are `lax.pmean`/`lax.psum` on the ``data`` axis, and
+under plain `jit` with sharding annotations GSPMD inserts them automatically.
+A host-side CPU ring-allreduce fallback (C++, `tpu_dp.ops.native`) backs the
+same semantics for host-only coordination outside any compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax import lax
+
+from tpu_dp.parallel.dist import DATA_AXIS
+
+
+def pmean(tree: Any, axis_name: str = DATA_AXIS) -> Any:
+    """All-reduce-mean a pytree across the mesh axis (inside shard_map/pmap).
+
+    The TPU-native form of DDP's gradient averaging: the reference's C++
+    `Reducer` fires NCCL allreduces from autograd hooks during backward
+    (`cifar_example_ddp.py:83`); here the mean is one more op XLA schedules
+    and fuses into the compiled train step.
+    """
+    return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis_name), tree)
+
+
+def psum(tree: Any, axis_name: str = DATA_AXIS) -> Any:
+    """All-reduce-sum a pytree across the mesh axis (inside shard_map/pmap).
+
+    Backs metric state sync — the equivalent of
+    `torchmetrics.Accuracy(dist_sync_on_step=True)`'s per-update allreduce
+    (`cifar_example_ddp.py:124,133`).
+    """
+    return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), tree)

@@ -1,0 +1,168 @@
+"""Process bootstrap, device mesh construction, and introspection.
+
+Replaces the reference's `init_distributed` (`cifar_example_ddp.py:42-58`),
+which reads `RANK`/`WORLD_SIZE`/`LOCAL_RANK` from the `torchrun` env, pins the
+CUDA device, hardcodes a `127.0.0.1:29500` rendezvous, creates the NCCL
+process group, and barriers. Here the same contract is expressed TPU-first:
+
+- one OS process per *host* (not per chip); the TPU runtime exposes all local
+  chips to the process, and `jax.distributed.initialize` wires multi-host.
+- the "world" is a `jax.sharding.Mesh` with a named ``data`` axis spanning
+  every chip in the slice; single-chip and N-chip are the same code path with
+  different mesh shapes (fixing the reference's single/DDP script fork — its
+  non-distributed fallback at `cifar_example_ddp.py:46-50` leaves `main`
+  broken because `DistributedSampler`/DDP still require a process group).
+- `barrier()` is a device-level psum of a unit scalar across the mesh plus the
+  coordinator-level sync, replacing `dist.barrier()` (`cifar_example_ddp.py:58`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+# Reserved second axis so the mesh API does not preclude tensor/model
+# parallelism later (SURVEY.md §2 "Parallelism strategies"); size 1 for DP.
+MODEL_AXIS = "model"
+
+_initialized_distributed = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DistContext:
+    """Resolved distributed topology for this process.
+
+    The TPU-native analogue of the reference's `args.distributed`,
+    `args.gpu`, `args.world_size` triple set by `init_distributed`
+    (`cifar_example_ddp.py:44-52`).
+    """
+
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+    coordinator_address: str | None
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.process_count > 1
+
+    @property
+    def is_main_process(self) -> bool:
+        return self.process_index == 0
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> DistContext:
+    """Bootstrap multi-host JAX if requested; always return the topology.
+
+    Mirrors the env-var contract of the reference (`cifar_example_ddp.py:43-45`
+    reads RANK/WORLD_SIZE from `torchrun`): if the standard JAX coordination
+    env vars — or explicit arguments — are present, call
+    `jax.distributed.initialize`; otherwise run single-process (which still
+    sees every local chip). Unlike the reference, the fallback path is fully
+    functional: the rest of the framework only consumes the returned mesh.
+    """
+    global _initialized_distributed
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "TPU_DP_COORDINATOR", os.environ.get("JAX_COORDINATOR_ADDRESS")
+    )
+    if num_processes is None and "TPU_DP_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["TPU_DP_NUM_PROCESSES"])
+    if process_id is None and "TPU_DP_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["TPU_DP_PROCESS_ID"])
+
+    want_multiprocess = coordinator_address is not None and (
+        num_processes is None or num_processes > 1
+    )
+    if want_multiprocess and not _initialized_distributed:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized_distributed = True
+
+    return DistContext(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+        coordinator_address=coordinator_address,
+    )
+
+
+def shutdown() -> None:
+    """Tear down the coordination service (multi-process runs only)."""
+    global _initialized_distributed
+    if _initialized_distributed:
+        jax.distributed.shutdown()
+        _initialized_distributed = False
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def data_mesh(
+    devices: Sequence[jax.Device] | None = None,
+    num_devices: int | None = None,
+) -> Mesh:
+    """Build the 1-D ``data`` mesh over all (or the first N) devices.
+
+    This is the framework's "world": the reference's `world_size`
+    (`cifar_example_ddp.py:44`) is `mesh.shape['data']`. Gradient averaging,
+    metric sync, and the input-pipeline shard count all key off this axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} present"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def barrier(mesh: Mesh | None = None) -> None:
+    """Block until every participant reaches this point.
+
+    Replaces `dist.barrier()` (`cifar_example_ddp.py:58`). Device level: a
+    jitted sum of a unit scalar sharded over the mesh forces a cross-chip
+    all-reduce; blocking on the result synchronizes the devices. Host level:
+    in multi-process runs the same executed collective synchronizes the
+    processes, since every process must dispatch its shard.
+    """
+    if mesh is None:
+        mesh = data_mesh()
+    n = mesh.devices.size
+    ones = jax.device_put(
+        np.ones((n,), dtype=np.int32),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(DATA_AXIS)),
+    )
+    total = int(jax.jit(lambda x: x.sum())(ones))
+    if total != n:
+        raise RuntimeError(f"barrier psum returned {total}, expected {n}")

@@ -1,0 +1,55 @@
+"""Learning-rate schedules.
+
+The reference has none — lr is a hardcoded constant 0.001
+(`/root/reference/cifar_example.py:64`), with no warmup and no scaling with
+world size (SURVEY.md §2A "Optimizer config"). BASELINE.json config 5 adds
+"cosine LR at global batch 4096", so cosine-with-linear-warmup is provided as
+a jit-traceable function of the step counter (pure jnp — schedules change no
+compiled code, the lr is just a traced scalar).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def constant_lr(lr: float) -> Schedule:
+    """The reference's schedule: lr forever (`cifar_example.py:64`)."""
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+def cosine_lr(
+    base_lr: float,
+    total_steps: int,
+    warmup_steps: int = 0,
+    final_lr: float = 0.0,
+) -> Schedule:
+    """Linear warmup 0→base over `warmup_steps`, cosine decay to `final_lr`."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = base_lr * step / jnp.maximum(1.0, warmup_steps)
+        decay_steps = jnp.maximum(1.0, total_steps - warmup_steps)
+        t = jnp.clip((step - warmup_steps) / decay_steps, 0.0, 1.0)
+        cos = final_lr + 0.5 * (base_lr - final_lr) * (1.0 + jnp.cos(jnp.pi * t))
+        return jnp.where(step < warmup_steps, warm, cos).astype(jnp.float32)
+
+    return schedule
+
+
+def make_schedule(
+    name: str,
+    base_lr: float,
+    total_steps: int = 0,
+    warmup_steps: int = 0,
+    final_lr: float = 0.0,
+) -> Schedule:
+    if name == "constant":
+        return constant_lr(base_lr)
+    if name == "cosine":
+        return cosine_lr(base_lr, total_steps, warmup_steps, final_lr)
+    raise ValueError(f"unknown schedule {name!r}")

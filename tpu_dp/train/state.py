@@ -1,0 +1,54 @@
+"""Train state: the complete pytree the compiled step transforms.
+
+Bundles what the reference scatters across mutable Python objects —
+`net.parameters()` (implicit in the module), SGD momentum buffers (inside
+`optim.SGD`, `/root/reference/cifar_example.py:64`), and the step counter
+(the loop index `i`, `cifar_example.py:69`) — into one immutable pytree, so
+`state' = step(state, batch)` is a pure function XLA can compile and shard.
+Checkpointing the whole training run (SURVEY.md §5 checkpoint gap) is then
+just serializing this pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray  # scalar int32
+    params: Any
+    opt_state: Any
+    batch_stats: Any  # {} for models without BatchNorm (e.g. `Net`)
+
+    @property
+    def has_batch_stats(self) -> bool:
+        return bool(self.batch_stats)
+
+
+def create_train_state(
+    model,
+    rng: jax.Array,
+    sample_input,
+    optimizer,
+) -> TrainState:
+    """Initialize params (+ batch stats) and optimizer slots.
+
+    Parameter init is deterministic in `rng` on every process, which gives
+    the replica-consistent start DDP gets from its wrap-time parameter
+    broadcast (`cifar_example_ddp.py:83`) — no broadcast needed when all
+    replicas compute the same init.
+    """
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=optimizer.init(params),
+        batch_stats=batch_stats,
+    )

@@ -1,0 +1,168 @@
+"""The compiled train/eval steps — the framework's hot loop.
+
+The reference's hot loop (`/root/reference/cifar_example_ddp.py:94-107`,
+SURVEY.md §3.2) is five eager calls per step with NCCL allreduces fired from
+C++ autograd hooks during `loss.backward()`. Here the *entire* loop body is
+one jitted XLA program:
+
+    loss, grads = value_and_grad(xent ∘ model)(params, global_batch)
+    params, opt = sgd(params, grads, lr(step))
+
+with the global batch *sharded* over the ``data`` mesh axis and the state
+*replicated*. Because the loss is a mean over the logical global batch, XLA's
+partitioner (GSPMD) materializes the cross-chip gradient all-reduce inside
+the compiled program — the same collective DDP runs from hooks, but fused,
+scheduled alongside compute by the compiler, and overlap-optimized over ICI.
+Donation reuses the state's device buffers across steps (no allocator
+churn). Single-chip is the same program on a mesh of one.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from tpu_dp.parallel.sharding import batch_sharding, replicated_sharding
+from tpu_dp.train.optim import Optimizer
+from tpu_dp.train.schedule import Schedule
+from tpu_dp.train.state import TrainState
+
+
+def cross_entropy_loss(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    weight: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """(Weighted) mean softmax cross-entropy from integer labels.
+
+    Parity with `nn.CrossEntropyLoss()` (reduction='mean', raw logits in)
+    (`/root/reference/cifar_example.py:63`). Computed in float32 regardless
+    of the model's compute dtype (bf16-safe reduction). ``weight`` masks
+    padded examples out of the mean (eval's final partial batch).
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(
+        logits, labels[:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
+    per_example = logz - true_logit
+    if weight is None:
+        return jnp.mean(per_example)
+    return jnp.sum(per_example * weight) / jnp.maximum(jnp.sum(weight), 1.0)
+
+
+def _apply_model(model, state: TrainState, images, train: bool):
+    """Run the model, handling BatchNorm's mutable running stats."""
+    if state.has_batch_stats:
+        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        if train:
+            logits, mutated = model.apply(
+                variables, images, train=True, mutable=["batch_stats"]
+            )
+            return logits, mutated["batch_stats"]
+        return model.apply(variables, images, train=False), state.batch_stats
+    return model.apply({"params": state.params}, images, train=train), {}
+
+
+def make_train_step(
+    model,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    schedule: Schedule,
+) -> Callable:
+    """Build the jitted DP train step for this model/optimizer/mesh.
+
+    Returns ``step(state, batch) -> (new_state, metrics)`` where ``batch``
+    is the device-placed global batch (leading dim sharded over ``data``)
+    and metrics are replicated scalars: mean loss, correct-prediction count,
+    and example count — the per-step statistics the reference prints
+    (`cifar_example.py:83-87`) plus what its synced eval metric accumulates
+    (`cifar_example_ddp.py:133`).
+    """
+    repl = replicated_sharding(mesh)
+    batch_sh = batch_sharding(mesh)
+
+    def step(state: TrainState, batch):
+        images, labels = batch["image"], batch["label"]
+
+        def loss_fn(params):
+            logits, new_batch_stats = _apply_model(
+                model, state.replace(params=params), images, train=True
+            )
+            # Train batches are always full (drop_remainder enforced), so no
+            # weight mask on the training loss.
+            return cross_entropy_loss(logits, labels), (logits, new_batch_stats)
+
+        (loss, (logits, new_batch_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        lr = schedule(state.step)
+        new_params, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params, lr
+        )
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            batch_stats=new_batch_stats,
+        )
+        predictions = jnp.argmax(logits, axis=-1)
+        metrics = {
+            "loss": loss,
+            "correct": jnp.sum(predictions == labels),
+            "count": jnp.asarray(labels.shape[0], jnp.int32),
+            "lr": lr,
+        }
+        return new_state, metrics
+
+    # `batch_sh` is a pytree-prefix: every batch leaf (image, label, and
+    # the optional weight mask) shards on its leading dim.
+    return jax.jit(
+        step,
+        in_shardings=(repl, batch_sh),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+
+
+def make_eval_step(model, mesh: Mesh) -> Callable:
+    """Build the jitted eval step: global (correct, count) per batch.
+
+    Parity with the reference's synced eval
+    (`cifar_example_ddp.py:124-136`): torchmetrics allreduces
+    correct/total state on every update (`dist_sync_on_step=True`). Here each
+    batch's counts are computed over the *sharded global* batch, so the
+    cross-chip reduction is inside the compiled step and the returned scalars
+    are already exact global values — same semantics, one fused collective.
+    Uses running statistics for BatchNorm models (`train=False`); the
+    reference never calls `.eval()` (`cifar_example_ddp.py:130` — moot for
+    its BN-free `Net`, divergence documented per SURVEY.md §3.4).
+    """
+    repl = replicated_sharding(mesh)
+    batch_sh = batch_sharding(mesh)
+
+    def step(state: TrainState, batch):
+        images, labels = batch["image"], batch["label"]
+        weight = batch.get("weight")
+        logits, _ = _apply_model(model, state, images, train=False)
+        predictions = jnp.argmax(logits, axis=-1)
+        if weight is None:
+            correct = jnp.sum(predictions == labels)
+            count = jnp.asarray(labels.shape[0], jnp.int32)
+        else:
+            correct = jnp.sum((predictions == labels) * weight).astype(jnp.int32)
+            count = jnp.sum(weight).astype(jnp.int32)
+        return {
+            "loss": cross_entropy_loss(logits, labels, weight),
+            "correct": correct,
+            "count": count,
+        }
+
+    return jax.jit(
+        step,
+        in_shardings=(repl, batch_sh),
+        out_shardings=repl,
+    )

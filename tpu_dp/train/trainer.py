@@ -1,0 +1,269 @@
+"""Trainer: epochs, logging, eval, checkpoint — the reference's `main()`.
+
+One code path from one chip to a full slice (mesh shape is the only
+variable), replacing the reference's forked `cifar_example.py` /
+`cifar_example_ddp.py` pair. Reproduces the observable behavior of
+`/root/reference/cifar_example_ddp.py:90-136`: per-epoch `set_epoch`
+reshuffle (`:92`), running-loss print every `log_every` steps in the
+reference's exact format (`:111-114`, but process-0-gated and with a correct
+remainder divisor), end-of-training weights export (`:118-119`), and a
+synced-accuracy eval (`:124-136`) — plus what the reference lacks: resume,
+throughput metering, and profiler hooks (SURVEY.md §5).
+
+Hot-loop discipline: the Python loop only *dispatches* compiled steps and
+accumulates the returned replicated scalars with on-device adds — it never
+blocks on a device→host transfer except at log boundaries and epoch ends, so
+host dispatch runs ahead of device execution and the input pipeline's
+prefetch overlaps (unlike the reference, whose `loss.item()` syncs every
+step, `cifar_example.py:83`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from tpu_dp import checkpoint as ckpt_lib
+from tpu_dp.config import Config
+from tpu_dp.data.cifar import load_dataset
+from tpu_dp.data.pipeline import DataPipeline
+from tpu_dp.metrics import Accuracy, Mean
+from tpu_dp.models import build_model
+from tpu_dp.parallel import dist
+from tpu_dp.train.optim import SGD
+from tpu_dp.train.schedule import make_schedule
+from tpu_dp.train.state import create_train_state
+from tpu_dp.train.step import make_eval_step, make_train_step
+from tpu_dp.utils import ThroughputMeter, log0, print0, profile_trace
+
+
+class Trainer:
+    def __init__(self, cfg: Config, mesh=None):
+        self.cfg = cfg
+        self.ctx = dist.initialize(
+            cfg.parallel.coordinator_address,
+            cfg.parallel.num_processes,
+            cfg.parallel.process_id,
+        )
+        self.mesh = mesh if mesh is not None else dist.data_mesh(
+            num_devices=cfg.parallel.num_devices
+        )
+        self.num_devices = int(self.mesh.devices.size)
+
+        self._load_data(cfg)
+
+        # The dataset determines the number of classes; an explicit config
+        # value must agree (a silently mis-sized head clamps labels inside
+        # the compiled loss and trains garbage with no error).
+        num_classes = self.train_ds.num_classes
+        if cfg.model.num_classes is not None and (
+            cfg.model.num_classes != num_classes
+        ):
+            raise ValueError(
+                f"model.num_classes={cfg.model.num_classes} conflicts with "
+                f"dataset {self.train_ds.name!r} ({num_classes} classes)"
+            )
+
+        import jax.numpy as jnp  # local: keep module import light
+
+        dtype = jnp.bfloat16 if cfg.model.bf16 else jnp.float32
+        self.model = build_model(cfg.model.name, num_classes=num_classes,
+                                 dtype=dtype)
+
+        self.train_pipe = DataPipeline(
+            self.train_ds, cfg.data.batch_size, self.mesh,
+            shuffle=cfg.data.shuffle, seed=cfg.train.seed,
+            drop_remainder=cfg.data.drop_remainder, prefetch=cfg.data.prefetch,
+        )
+        self.test_pipe = DataPipeline(
+            self.test_ds, cfg.data.batch_size, self.mesh,
+            shuffle=False, seed=cfg.train.seed,
+            drop_remainder=False, prefetch=cfg.data.prefetch,
+        )
+
+        steps_per_epoch = len(self.train_pipe)
+        total_steps = steps_per_epoch * cfg.train.epochs
+        self.optimizer = SGD(cfg.optim.momentum, cfg.optim.weight_decay)
+        self.schedule = make_schedule(
+            cfg.optim.schedule, cfg.optim.lr, total_steps,
+            int(cfg.optim.warmup_epochs * steps_per_epoch), cfg.optim.final_lr,
+        )
+        self.train_step = make_train_step(
+            self.model, self.optimizer, self.mesh, self.schedule
+        )
+        self.eval_step = make_eval_step(self.model, self.mesh)
+
+        rng = jax.random.PRNGKey(cfg.train.seed)
+        sample = np.zeros((1, 32, 32, 3), np.float32)
+        self.state = create_train_state(self.model, rng, sample, self.optimizer)
+        self.start_epoch = 0
+        self.meter = ThroughputMeter(warmup_steps=2)
+
+        if cfg.train.resume:
+            self._maybe_resume()
+
+    def _load_data(self, cfg: Config) -> None:
+        """Process 0 materializes the dataset first; the rest then read it.
+
+        Fixes the reference's download race — every rank extracting into the
+        shared `./data` dir concurrently (`cifar_example_ddp.py:67-68,73-74`,
+        SURVEY.md §5 "Race detection").
+        """
+
+        def _load():
+            train = load_dataset(
+                cfg.data.dataset, cfg.data.root, train=True,
+                allow_synthetic=cfg.data.allow_synthetic,
+                synthetic_num_examples=cfg.data.synthetic_train_size,
+                seed=cfg.train.seed,
+            )
+            test = load_dataset(
+                cfg.data.dataset, cfg.data.root, train=False,
+                allow_synthetic=cfg.data.allow_synthetic,
+                synthetic_num_examples=cfg.data.synthetic_test_size,
+                seed=cfg.train.seed,
+            )
+            return train, test
+
+        if self.ctx.process_count == 1:
+            self.train_ds, self.test_ds = _load()
+            return
+        from jax.experimental import multihost_utils
+
+        if self.ctx.process_index == 0:
+            self.train_ds, self.test_ds = _load()
+        multihost_utils.sync_global_devices("tpu_dp_data_materialized")
+        if self.ctx.process_index != 0:
+            self.train_ds, self.test_ds = _load()
+
+    def _maybe_resume(self) -> None:
+        """Resume from checkpoint, agreed across processes.
+
+        Checkpoints are written by process 0 only; on a pod each host has
+        its own disk, so the resume decision and the restored state must
+        come from process 0 (otherwise replicas desync: some resume, some
+        start fresh).
+        """
+        cfg = self.cfg
+        exists = ckpt_lib.checkpoint_exists(cfg.train.ckpt_dir)
+        if self.ctx.process_count == 1:
+            if not exists:
+                return
+            self.state, meta = ckpt_lib.load_checkpoint(
+                cfg.train.ckpt_dir, self.state
+            )
+            self.start_epoch = int(meta.get("epoch", -1)) + 1
+        else:
+            from jax.experimental import multihost_utils
+
+            exists0 = bool(
+                int(multihost_utils.broadcast_one_to_all(np.int32(exists)))
+            )
+            if not exists0:
+                return
+            if self.ctx.process_index == 0:
+                state, meta = ckpt_lib.load_checkpoint(
+                    cfg.train.ckpt_dir, self.state
+                )
+                epoch = np.int32(int(meta.get("epoch", -1)))
+            else:
+                state, epoch = self.state, np.int32(-1)
+            host_state = jax.tree_util.tree_map(np.asarray, state)
+            self.state = multihost_utils.broadcast_one_to_all(host_state)
+            self.start_epoch = int(multihost_utils.broadcast_one_to_all(epoch)) + 1
+        log0("resumed from %s at epoch %d (step %d)",
+             cfg.train.ckpt_dir, self.start_epoch, int(self.state.step))
+
+    @property
+    def global_batch_size(self) -> int:
+        """Logical per-step batch: per-process batch × processes (the
+        reference's batch-4-per-rank × world accounting, SURVEY.md §2A)."""
+        return self.cfg.data.batch_size * self.ctx.process_count
+
+    def train_epoch(self, epoch: int) -> dict[str, float]:
+        cfg = self.cfg
+        self.train_pipe.set_epoch(epoch)  # `cifar_example_ddp.py:92` parity
+        gbs = self.global_batch_size
+        run_loss, run_steps = None, 0  # device-side running-loss accumulator
+        ep_loss = ep_correct = None
+        ep_steps, ep_count = 0, 0
+        for i, batch in enumerate(self.train_pipe):
+            self.state, m = self.train_step(self.state, batch)
+            # On-device async adds; no host sync inside the loop.
+            run_loss = m["loss"] if run_loss is None else run_loss + m["loss"]
+            run_steps += 1
+            ep_loss = m["loss"] if ep_loss is None else ep_loss + m["loss"]
+            ep_correct = (
+                m["correct"] if ep_correct is None else ep_correct + m["correct"]
+            )
+            ep_steps += 1
+            ep_count += gbs
+            self.meter.step(gbs)
+            if i % cfg.train.log_every == cfg.train.log_every - 1:
+                # Reference print format (`cifar_example.py:85-86`); the
+                # float() here is the only sync per log interval.
+                print0("[%d, %5d] loss: %.3f"
+                       % (epoch + 1, i + 1, float(run_loss) / run_steps))
+                run_loss, run_steps = None, 0
+        stats = {
+            "loss": float(ep_loss) / max(1, ep_steps) if ep_steps else 0.0,
+            "accuracy": float(ep_correct) / ep_count if ep_count else 0.0,
+        }
+        self.meter.mark()  # fence: epoch stats fetched, device drained
+        return stats
+
+    def evaluate(self) -> dict[str, float]:
+        acc = Accuracy()
+        loss = Mean()
+        for batch in self.test_pipe:
+            m = self.eval_step(self.state, batch)
+            n = int(m["count"])
+            acc.update(m["correct"], n)
+            loss.update(float(m["loss"]), n)
+        return {"accuracy": acc.compute(), "loss": loss.compute()}
+
+    def fit(self) -> dict[str, Any]:
+        cfg = self.cfg
+        log0(
+            "training %s on %s: %d device(s), %d process(es), "
+            "global batch %d (%d/process), %d epochs",
+            cfg.model.name, self.train_ds.name, self.num_devices,
+            self.ctx.process_count, self.global_batch_size,
+            cfg.data.batch_size, cfg.train.epochs,
+        )
+        t0 = time.perf_counter()
+        history = []
+        with profile_trace(cfg.train.profile_dir):
+            for epoch in range(self.start_epoch, cfg.train.epochs):
+                stats = self.train_epoch(epoch)
+                history.append(stats)
+                log0("epoch %d: train loss %.4f acc %.4f (%.1f img/s)",
+                     epoch + 1, stats["loss"], stats["accuracy"],
+                     self.meter.images_per_sec)
+                ckpt_lib.save_checkpoint(
+                    cfg.train.ckpt_dir, self.state,
+                    {"epoch": epoch, "config": cfg.to_dict(),
+                     "seed": cfg.train.seed},
+                )
+        print0("Finished Training")  # `cifar_example.py:90` parity
+        wall = time.perf_counter() - t0
+
+        # End-of-training weights export (`cifar_example.py:92-93` analogue).
+        ckpt_lib.save_params(f"{cfg.train.ckpt_dir}/final_params.msgpack",
+                             self.state.params)
+
+        result: dict[str, Any] = {
+            "history": history,
+            "wall_time_s": wall,
+            "images_per_sec": self.meter.images_per_sec,
+        }
+        if cfg.train.eval_at_end:
+            eval_stats = self.evaluate()
+            result["eval"] = eval_stats
+            # Reference integer-percent print (`cifar_example.py:111-112`).
+            print0("Accuracy of the network on the %d test images: %d %%"
+                   % (len(self.test_ds), int(100 * eval_stats["accuracy"])))
+        return result

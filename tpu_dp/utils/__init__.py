@@ -1,0 +1,7 @@
+"""Utilities: rank-0 logging, throughput metering, profiling hooks."""
+
+from tpu_dp.utils.logging import get_logger, log0, print0
+from tpu_dp.utils.meter import ThroughputMeter
+from tpu_dp.utils.profiling import profile_trace
+
+__all__ = ["ThroughputMeter", "get_logger", "log0", "print0", "profile_trace"]

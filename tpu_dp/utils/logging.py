@@ -1,0 +1,45 @@
+"""Rank-0-gated logging.
+
+The reference prints from *every* rank — no gating anywhere in the DDP
+script (SURVEY.md §2A quirks; `/root/reference/cifar_example_ddp.py:111-114,
+135-136`), so an 8-rank run prints everything 8×. Here all human-facing
+output flows through process-0-gated helpers.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import jax
+
+_logger: logging.Logger | None = None
+
+
+def get_logger() -> logging.Logger:
+    global _logger
+    if _logger is None:
+        logger = logging.getLogger("tpu_dp")
+        if not logger.handlers:
+            handler = logging.StreamHandler(sys.stdout)
+            handler.setFormatter(
+                logging.Formatter("[%(asctime)s tpu_dp p%(process)d] %(message)s",
+                                  datefmt="%H:%M:%S")
+            )
+            logger.addHandler(handler)
+            logger.setLevel(logging.INFO)
+            logger.propagate = False
+        _logger = logger
+    return _logger
+
+
+def log0(msg: str, *args) -> None:
+    """Log from process 0 only."""
+    if jax.process_index() == 0:
+        get_logger().info(msg, *args)
+
+
+def print0(*args, **kwargs) -> None:
+    """Print from process 0 only (reference-parity formatted prints)."""
+    if jax.process_index() == 0:
+        print(*args, **kwargs)

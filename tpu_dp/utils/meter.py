@@ -1,0 +1,63 @@
+"""Step-time / images-per-second metering.
+
+The reference has zero timing instrumentation (SURVEY.md §5 "Tracing /
+profiling — ABSENT"), but images/sec/chip is the BASELINE.json north-star
+metric, so the meter is a required subsystem. Excludes a configurable number
+of warmup steps (compilation happens on step 0).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class ThroughputMeter:
+    def __init__(self, warmup_steps: int = 2):
+        self.warmup_steps = warmup_steps
+        self.reset()
+
+    def reset(self) -> None:
+        self._steps = 0
+        self._images = 0
+        self._start: float | None = None
+        self._last: float | None = None
+
+    def step(self, batch_size: int) -> None:
+        """Call after each dispatched step."""
+        now = time.perf_counter()
+        self._steps += 1
+        if self._steps == self.warmup_steps:
+            self._start = now
+            self._images = 0
+        elif self._steps > self.warmup_steps:
+            self._images += batch_size
+        self._last = now
+
+    def mark(self) -> None:
+        """Record 'now' as the end of measured work.
+
+        Call after a true host↔device fence (e.g. fetching a metric scalar):
+        step() timestamps dispatch, which runs ahead of device execution, so
+        without a fence the rate would be a dispatch rate, not a throughput.
+        """
+        if self._steps > self.warmup_steps:
+            self._last = time.perf_counter()
+
+    @property
+    def measured_steps(self) -> int:
+        return max(0, self._steps - self.warmup_steps)
+
+    @property
+    def elapsed(self) -> float:
+        if self._start is None or self._last is None:
+            return 0.0
+        return self._last - self._start
+
+    @property
+    def images_per_sec(self) -> float:
+        return self._images / self.elapsed if self.elapsed > 0 else 0.0
+
+    @property
+    def step_time_ms(self) -> float:
+        n = self.measured_steps
+        return (self.elapsed / n) * 1e3 if n > 0 else 0.0

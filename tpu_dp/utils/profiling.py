@@ -1,0 +1,21 @@
+"""Profiler hooks — `jax.profiler` traces viewable in TensorBoard/Perfetto.
+
+The reference has no profiler (SURVEY.md §5); this wraps the train loop in
+an XLA trace context when a trace dir is configured.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: str | None):
+    """Trace the enclosed region to `trace_dir` when set; no-op otherwise."""
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            yield
+    else:
+        yield

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Train entry point — one script, one code path, any mesh size.
+
+Replaces the reference's forked pair (`/root/reference/cifar_example.py` run
+directly vs `cifar_example_ddp.py` under `torchrun --nproc_per_node=N`): the
+same command runs single-chip or across a full slice; parallelism comes from
+the visible devices (and, multi-host, from `--parallel.*` / the standard JAX
+coordination env), not from a launcher fork.
+
+Usage:
+    python train.py                                  # reference parity: Net, batch 4, 2 epochs
+    python train.py --preset=resnet18_cifar10
+    python train.py --preset=bf16_cosine_gb4096 --train.epochs=5
+    python train.py --data.dataset=synthetic --train.log_every=50
+
+Any config field is overridable as `--section.field=value` (see
+`tpu_dp/config.py`).
+"""
+
+import json
+import sys
+
+from tpu_dp.config import parse_cli
+from tpu_dp.train.trainer import Trainer
+from tpu_dp.utils import print0
+
+
+def main(argv=None) -> int:
+    cfg = parse_cli(sys.argv[1:] if argv is None else argv)
+    trainer = Trainer(cfg)
+    result = trainer.fit()
+    summary = {
+        "model": cfg.model.name,
+        "dataset": trainer.train_ds.name,
+        "synthetic": trainer.train_ds.synthetic,
+        "devices": trainer.num_devices,
+        "images_per_sec": round(result["images_per_sec"], 1),
+        "wall_time_s": round(result["wall_time_s"], 1),
+        "final_train_loss": round(result["history"][-1]["loss"], 4)
+        if result["history"] else None,
+        "eval": result.get("eval"),
+    }
+    print0(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
