@@ -1,0 +1,81 @@
+"""Native (C++) host library tests — topology + TCP ring allreduce.
+
+SURVEY.md §4 "Multi-process without a cluster": N local processes over a
+loopback rendezvous, the analogue of the reference's `127.0.0.1:29500`
+TCPStore (`cifar_example_ddp.py:55-56`). The ring must be semantically
+identical to the XLA collective path: allreduce(sum/mean) + barrier
+(SURVEY.md §7 hard part (c)).
+"""
+
+import multiprocessing as mp
+import pickle
+
+import numpy as np
+import pytest
+
+from tpu_dp.ops.native import available, cpu_count, hostname
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native host library failed to build"
+)
+
+
+def test_topology_introspection():
+    assert cpu_count() >= 1
+    assert isinstance(hostname(), str) and hostname()
+
+
+def _ring_worker(rank, world, base_port, conn):
+    try:
+        from tpu_dp.ops.native.hostlib import Ring
+
+        rng = np.random.default_rng(rank)
+        data = rng.normal(size=257).astype(np.float32)  # odd size: uneven chunks
+        with Ring("127.0.0.1", base_port, rank, world, timeout_ms=20_000) as ring:
+            summed = ring.allreduce(data.copy(), op="sum")
+            meaned = ring.allreduce(data.copy(), op="mean")
+            ring.barrier()
+        conn.send(pickle.dumps((rank, data, summed, meaned)))
+    except BaseException as e:  # surface the failure to the parent
+        conn.send(pickle.dumps(e))
+    finally:
+        conn.close()
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_ring_allreduce_multiprocess(world):
+    ctx = mp.get_context("spawn")
+    base_port = 23450 + world * 16
+    pipes, procs = [], []
+    for rank in range(world):
+        parent, child = ctx.Pipe()
+        p = ctx.Process(
+            target=_ring_worker, args=(rank, world, base_port, child)
+        )
+        p.start()
+        pipes.append(parent)
+        procs.append(p)
+    results = []
+    for parent, p in zip(pipes, procs):
+        payload = pickle.loads(parent.recv())
+        p.join(timeout=30)
+        if isinstance(payload, BaseException):
+            raise payload
+        results.append(payload)
+
+    expected_sum = np.sum([r[1] for r in results], axis=0)
+    for rank, _, summed, meaned in results:
+        np.testing.assert_allclose(summed, expected_sum, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(
+            meaned, expected_sum / world, rtol=1e-5, atol=1e-5
+        )
+
+
+def test_ring_world_one_is_identity():
+    from tpu_dp.ops.native.hostlib import Ring
+
+    data = np.arange(5, dtype=np.float32)
+    with Ring("127.0.0.1", 23900, 0, 1) as ring:
+        out = ring.allreduce(data.copy(), op="mean")
+        ring.barrier()
+    np.testing.assert_array_equal(out, data)
