@@ -1,0 +1,61 @@
+"""Determinism checks: same seed ⇒ identical params; replicas bitwise equal."""
+
+import jax
+import numpy as np
+
+from tpu_dp.data.cifar import make_synthetic, normalize
+from tpu_dp.models import Net
+from tpu_dp.parallel.sharding import replicated_sharding, shard_batch
+from tpu_dp.train import SGD, constant_lr, create_train_state, make_train_step
+from tpu_dp.utils.determinism import check_replica_consistency, local_digest
+
+
+def test_same_seed_same_init():
+    model, opt = Net(), SGD(0.9)
+    x = np.zeros((1, 32, 32, 3), np.float32)
+    a = create_train_state(model, jax.random.PRNGKey(5), x, opt)
+    b = create_train_state(model, jax.random.PRNGKey(5), x, opt)
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(a.params), jax.tree_util.tree_leaves(b.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert local_digest(a.params) == local_digest(b.params)
+
+
+def test_replicas_bitwise_consistent_after_training(mesh8):
+    model, opt = Net(), SGD(0.9)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32), opt
+    )
+    # Place the state replicated over all 8 devices, step it a few times,
+    # then check every device replica is bitwise identical.
+    state = jax.device_put(state, replicated_sharding(mesh8))
+    step = make_train_step(model, opt, mesh8, constant_lr(0.05))
+    ds = make_synthetic(64, 10, seed=0, name="det")
+    batch = shard_batch(
+        {"image": normalize(ds.images), "label": ds.labels}, mesh8
+    )
+    for _ in range(3):
+        state, _ = step(state, batch)
+    assert check_replica_consistency(state.params) == 0.0
+    assert check_replica_consistency(state.opt_state) == 0.0
+
+
+def test_divergent_replicas_detected(mesh8):
+    """Negative control: visibly different per-device data is flagged."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # A device-varying array disguised as 'one value per device': each shard
+    # covers a (1, 4) slice, so the full-replica filter skips it — build a
+    # genuinely replicated array, then corrupt one device's buffer by
+    # constructing from distinct per-device arrays.
+    devices = list(mesh8.devices.flat)
+    shards = [
+        jax.device_put(np.full((4,), float(i == 3), np.float32), d)
+        for i, d in enumerate(devices)
+    ]
+    arr = jax.make_array_from_single_device_arrays(
+        (4,), NamedSharding(mesh8, P()), shards
+    )
+    assert check_replica_consistency([arr]) == 1.0

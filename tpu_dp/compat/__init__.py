@@ -1,0 +1,13 @@
+"""Interop with the reference's torch checkpoint format."""
+
+from tpu_dp.compat.torch_compat import (
+    export_net_state_dict,
+    import_net_state_dict,
+    load_torch_checkpoint,
+)
+
+__all__ = [
+    "export_net_state_dict",
+    "import_net_state_dict",
+    "load_torch_checkpoint",
+]

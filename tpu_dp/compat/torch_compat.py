@@ -1,0 +1,100 @@
+"""Torch `state_dict` ↔ Flax params conversion for the reference's `Net`.
+
+The reference saves `net.state_dict()` to `./cifar_net.pth`
+(`/root/reference/cifar_example.py:92-93`); in the DDP variant the keys carry
+DDP's `module.` prefix (`cifar_example_ddp.py:118-119`, SURVEY.md §5
+checkpoint notes). This module closes the migration story (SURVEY.md §7 hard
+part (e)): weights trained with the reference import losslessly into the
+Flax `Net`, and vice versa. Three representation differences are mapped:
+
+1. `module.` prefix — stripped on import, never emitted on export;
+2. layout — torch convs are OIHW, Flax convs are HWIO; torch Linear weights
+   are (out, in), Flax Dense kernels are (in, out);
+3. flatten order — `Net` flattens the 16×5×5 conv2 output into fc1's input;
+   torch flattens NCHW as (C,H,W) while this framework's NHWC flattens as
+   (H,W,C), so fc1's input dimension is permuted accordingly.
+
+Functions take/return plain dicts of numpy arrays; `load_torch_checkpoint`
+soft-imports torch only to unpickle a `.pth` file.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# conv2 output feeding fc1: 16 channels × 5 × 5 spatial (`cifar_example.py:23`)
+_C, _H, _W = 16, 5, 5
+
+
+def _fc1_permutation() -> np.ndarray:
+    """perm[flax_row] = torch_column for fc1's 400-dim input."""
+    perm = np.empty(_C * _H * _W, dtype=np.int64)
+    for h in range(_H):
+        for w in range(_W):
+            for c in range(_C):
+                flax_idx = (h * _W + w) * _C + c  # NHWC flatten
+                torch_idx = (c * _H + h) * _W + w  # NCHW flatten
+                perm[flax_idx] = torch_idx
+    return perm
+
+
+def _strip_prefix(state_dict: dict) -> dict:
+    """Remove DDP's `module.` wrapper prefix if present."""
+    if any(k.startswith("module.") for k in state_dict):
+        return {k.removeprefix("module."): v for k, v in state_dict.items()}
+    return state_dict
+
+
+def import_net_state_dict(state_dict: dict) -> dict:
+    """Torch `Net` state_dict (numpy-valued) → Flax `Net` params tree."""
+    sd = {k: np.asarray(v) for k, v in _strip_prefix(state_dict).items()}
+    perm = _fc1_permutation()
+
+    def conv(name):
+        return {
+            "kernel": sd[f"{name}.weight"].transpose(2, 3, 1, 0),  # OIHW→HWIO
+            "bias": sd[f"{name}.bias"],
+        }
+
+    def dense(name, row_perm=None):
+        kernel = sd[f"{name}.weight"].T  # (out,in) → (in,out)
+        if row_perm is not None:
+            kernel = kernel[row_perm]
+        return {"kernel": kernel, "bias": sd[f"{name}.bias"]}
+
+    return {
+        "conv1": conv("conv1"),
+        "conv2": conv("conv2"),
+        "fc1": dense("fc1", perm),
+        "fc2": dense("fc2"),
+        "fc3": dense("fc3"),
+    }
+
+
+def export_net_state_dict(params: dict) -> dict:
+    """Flax `Net` params tree → torch-layout state_dict (clean key names)."""
+    perm = _fc1_permutation()
+    inv = np.argsort(perm)
+    out = {}
+    for name in ("conv1", "conv2"):
+        out[f"{name}.weight"] = np.asarray(
+            params[name]["kernel"]
+        ).transpose(3, 2, 0, 1)  # HWIO→OIHW
+        out[f"{name}.bias"] = np.asarray(params[name]["bias"])
+    for name in ("fc1", "fc2", "fc3"):
+        kernel = np.asarray(params[name]["kernel"])
+        if name == "fc1":
+            kernel = kernel[inv]
+        out[f"{name}.weight"] = kernel.T
+        out[f"{name}.bias"] = np.asarray(params[name]["bias"])
+    return out
+
+
+def load_torch_checkpoint(path) -> dict:
+    """Unpickle a reference `.pth` into a Flax `Net` params tree."""
+    import torch  # soft dependency: only needed to read torch's pickle format
+
+    sd = torch.load(path, map_location="cpu")
+    return import_net_state_dict(
+        {k: v.detach().numpy() for k, v in sd.items()}
+    )

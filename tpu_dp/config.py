@@ -55,6 +55,7 @@ class TrainConfig:
     ckpt_dir: str = "./checkpoints"
     resume: bool = False
     profile_dir: str | None = None  # enable jax.profiler traces when set
+    pallas_xent: bool = False  # fused Pallas softmax-xent kernel (TPU)
 
 
 @dataclass

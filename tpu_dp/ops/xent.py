@@ -1,0 +1,132 @@
+"""Fused softmax-cross-entropy Pallas TPU kernel (forward + custom VJP).
+
+The reference's loss is `nn.CrossEntropyLoss()` (`/root/reference/
+cifar_example.py:63`), lowered there to cuDNN/cuBLAS softmax+NLL kernels.
+XLA already fuses the logsumexp chain well; this kernel goes one step
+further and keeps the whole per-example computation — max, logsumexp,
+label gather (forward) and softmax-minus-onehot scaling (backward) — in
+VMEM with a single pass over the logits per direction, one (block_b, C)
+tile per grid step. For CIFAR head sizes (C = 10/100, padded to the
+128-lane tile) this trades a few HBM round trips of (B, C) intermediates
+for none.
+
+API: `softmax_xent(logits, labels) -> per-example loss (B,)`, differentiable
+wrt logits via `jax.custom_vjp`. Off-TPU the same kernels run in Pallas
+interpret mode, so tests exercise identical code on CPU. `tpu_dp.train.step`
+uses the jnp path by default; the kernel is opt-in (`use_pallas=True` /
+bench) and numerically validated against the jnp path in tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BLOCK_B = 256  # batch rows per grid step; (256, 128) f32 tiles fit VMEM easily
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(logits_ref, labels_ref, loss_ref):
+    logits = logits_ref[:].astype(jnp.float32)  # (B, C)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    shifted = logits - m
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)) + m
+    classes = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    onehot = (classes == labels_ref[:]).astype(jnp.float32)  # labels (B, 1)
+    true_logit = jnp.sum(logits * onehot, axis=-1, keepdims=True)
+    loss_ref[:] = lse - true_logit  # (B, 1)
+
+
+def _bwd_kernel(logits_ref, labels_ref, ct_ref, dlogits_ref):
+    logits = logits_ref[:].astype(jnp.float32)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    e = jnp.exp(logits - m)
+    probs = e / jnp.sum(e, axis=-1, keepdims=True)
+    classes = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    onehot = (classes == labels_ref[:]).astype(jnp.float32)
+    dlogits_ref[:] = ((probs - onehot) * ct_ref[:]).astype(dlogits_ref.dtype)
+
+
+def _block_specs(num_classes):
+    row_spec = pl.BlockSpec(
+        (_BLOCK_B, num_classes), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    col_spec = pl.BlockSpec(
+        (_BLOCK_B, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    return row_spec, col_spec
+
+
+def _pad_rows(x, block):
+    b = x.shape[0]
+    pad = (-b) % block
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, *x.shape[1:]), x.dtype)])
+    return x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def softmax_xent(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-example softmax cross-entropy, fused on TPU. Returns (B,)."""
+    return _run_fwd(logits, labels)
+
+
+def _run_fwd(logits, labels):
+    b, c = logits.shape
+    logits_p = _pad_rows(logits, _BLOCK_B)
+    labels_p = _pad_rows(labels.astype(jnp.int32)[:, None], _BLOCK_B)
+    row_spec, col_spec = _block_specs(c)
+    loss = pl.pallas_call(
+        _fwd_kernel,
+        grid=(logits_p.shape[0] // _BLOCK_B,),
+        in_specs=[row_spec, col_spec],
+        out_specs=col_spec,
+        out_shape=jax.ShapeDtypeStruct((logits_p.shape[0], 1), jnp.float32),
+        interpret=_interpret(),
+    )(logits_p, labels_p)
+    return loss[:b, 0]
+
+
+def _fwd_rule(logits, labels):
+    return _run_fwd(logits, labels), (logits, labels)
+
+
+def _bwd_rule(residuals, ct):
+    logits, labels = residuals
+    b, c = logits.shape
+    logits_p = _pad_rows(logits, _BLOCK_B)
+    labels_p = _pad_rows(labels.astype(jnp.int32)[:, None], _BLOCK_B)
+    ct_p = _pad_rows(ct.astype(jnp.float32)[:, None], _BLOCK_B)
+    row_spec, col_spec = _block_specs(c)
+    dlogits = pl.pallas_call(
+        _bwd_kernel,
+        grid=(logits_p.shape[0] // _BLOCK_B,),
+        in_specs=[row_spec, col_spec, col_spec],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct(logits_p.shape, logits.dtype),
+        interpret=_interpret(),
+    )(logits_p, labels_p, ct_p)
+    return dlogits[:b], None
+
+
+softmax_xent.defvjp(_fwd_rule, _bwd_rule)
+
+
+def mean_softmax_xent(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    weight: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """(Weighted) mean loss via the fused kernel — drop-in for
+    `tpu_dp.train.step.cross_entropy_loss`."""
+    per_example = softmax_xent(logits, labels)
+    if weight is None:
+        return jnp.mean(per_example)
+    return jnp.sum(per_example * weight) / jnp.maximum(jnp.sum(weight), 1.0)

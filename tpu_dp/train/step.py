@@ -72,6 +72,7 @@ def make_train_step(
     optimizer: Optimizer,
     mesh: Mesh,
     schedule: Schedule,
+    use_pallas_xent: bool = False,
 ) -> Callable:
     """Build the jitted DP train step for this model/optimizer/mesh.
 
@@ -84,6 +85,10 @@ def make_train_step(
     """
     repl = replicated_sharding(mesh)
     batch_sh = batch_sharding(mesh)
+    if use_pallas_xent:
+        from tpu_dp.ops.xent import mean_softmax_xent as loss_impl
+    else:
+        loss_impl = cross_entropy_loss
 
     def step(state: TrainState, batch):
         images, labels = batch["image"], batch["label"]
@@ -94,7 +99,7 @@ def make_train_step(
             )
             # Train batches are always full (drop_remainder enforced), so no
             # weight mask on the training loss.
-            return cross_entropy_loss(logits, labels), (logits, new_batch_stats)
+            return loss_impl(logits, labels), (logits, new_batch_stats)
 
         (loss, (logits, new_batch_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True

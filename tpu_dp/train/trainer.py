@@ -92,7 +92,8 @@ class Trainer:
             int(cfg.optim.warmup_epochs * steps_per_epoch), cfg.optim.final_lr,
         )
         self.train_step = make_train_step(
-            self.model, self.optimizer, self.mesh, self.schedule
+            self.model, self.optimizer, self.mesh, self.schedule,
+            use_pallas_xent=cfg.train.pallas_xent,
         )
         self.eval_step = make_eval_step(self.model, self.mesh)
 

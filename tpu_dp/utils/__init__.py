@@ -1,7 +1,21 @@
-"""Utilities: rank-0 logging, throughput metering, profiling hooks."""
+"""Utilities: rank-0 logging, throughput metering, profiling, determinism."""
 
+from tpu_dp.utils.determinism import (
+    check_cross_process_consistency,
+    check_replica_consistency,
+    local_digest,
+)
 from tpu_dp.utils.logging import get_logger, log0, print0
 from tpu_dp.utils.meter import ThroughputMeter
 from tpu_dp.utils.profiling import profile_trace
 
-__all__ = ["ThroughputMeter", "get_logger", "log0", "print0", "profile_trace"]
+__all__ = [
+    "ThroughputMeter",
+    "check_cross_process_consistency",
+    "check_replica_consistency",
+    "get_logger",
+    "local_digest",
+    "log0",
+    "print0",
+    "profile_trace",
+]
