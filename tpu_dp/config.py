@@ -44,6 +44,7 @@ class OptimConfig:
     schedule: str = "constant"  # constant | cosine
     warmup_epochs: float = 0.0
     final_lr: float = 0.0
+    grad_accum_steps: int = 1  # microbatches per optimizer update (lax.scan)
 
 
 @dataclass

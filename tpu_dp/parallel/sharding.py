@@ -29,16 +29,20 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch: Any, mesh: Mesh) -> Any:
+def shard_batch(batch: Any, mesh: Mesh, spec: P | None = None) -> Any:
     """Place a host batch pytree onto the mesh, sharded on dim 0.
 
     The host→device copy boundary of the reference's hot loop
     (`cifar_example_ddp.py:97-98`), hoisted out of the compiled step. In
     multi-process runs each process holds only its local shard of the global
     batch; `jax.make_array_from_process_local_data` assembles the logical
-    global array from per-process slices.
+    global array from per-process slices. ``spec`` overrides the default
+    leading-dim partitioning (e.g. ``P(None, 'data')`` for
+    gradient-accumulation batches with a scan axis in front).
     """
-    sharding = batch_sharding(mesh)
+    sharding = (
+        batch_sharding(mesh) if spec is None else NamedSharding(mesh, spec)
+    )
     if jax.process_count() > 1:
         return jax.tree_util.tree_map(
             lambda x: jax.make_array_from_process_local_data(sharding, x), batch

@@ -73,6 +73,7 @@ def make_train_step(
     mesh: Mesh,
     schedule: Schedule,
     use_pallas_xent: bool = False,
+    accum_steps: int = 1,
 ) -> Callable:
     """Build the jitted DP train step for this model/optimizer/mesh.
 
@@ -90,9 +91,7 @@ def make_train_step(
     else:
         loss_impl = cross_entropy_loss
 
-    def step(state: TrainState, batch):
-        images, labels = batch["image"], batch["label"]
-
+    def _forward_backward(state: TrainState, images, labels):
         def loss_fn(params):
             logits, new_batch_stats = _apply_model(
                 model, state.replace(params=params), images, train=True
@@ -104,6 +103,50 @@ def make_train_step(
         (loss, (logits, new_batch_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
+        correct = jnp.sum(jnp.argmax(logits, axis=-1) == labels)
+        return loss, grads, new_batch_stats, correct
+
+    def step(state: TrainState, batch):
+        images, labels = batch["image"], batch["label"]
+        if accum_steps == 1:
+            loss, grads, new_batch_stats, correct = _forward_backward(
+                state, images, labels
+            )
+            count = labels.shape[0]
+        else:
+            # Gradient accumulation: batch leaves carry a leading
+            # (accum_steps,) axis (replicated; the microbatch dim is the
+            # sharded one). lax.scan runs the microbatches sequentially,
+            # accumulating grads on-device; one optimizer update per step.
+            # This is how a logical global batch larger than HBM (e.g.
+            # BASELINE config 5's 4096) runs on few chips.
+            def micro(carry, mb):
+                grads_acc, batch_stats, loss_acc, correct_acc = carry
+                mstate = state.replace(batch_stats=batch_stats)
+                loss, grads, new_bs, correct = _forward_backward(
+                    mstate, mb["image"], mb["label"]
+                )
+                grads_acc = jax.tree_util.tree_map(
+                    jnp.add, grads_acc, grads
+                )
+                return (grads_acc, new_bs, loss_acc + loss,
+                        correct_acc + correct), None
+
+            init = (
+                jax.tree_util.tree_map(jnp.zeros_like, state.params),
+                state.batch_stats,
+                jnp.zeros((), jnp.float32),
+                jnp.zeros((), jnp.int32),
+            )
+            (grads, new_batch_stats, loss_sum, correct), _ = jax.lax.scan(
+                micro, init, {"image": images, "label": labels}
+            )
+            grads = jax.tree_util.tree_map(
+                lambda g: g / accum_steps, grads
+            )
+            loss = loss_sum / accum_steps
+            count = labels.shape[0] * labels.shape[1]
+
         lr = schedule(state.step)
         new_params, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params, lr
@@ -114,20 +157,28 @@ def make_train_step(
             opt_state=new_opt_state,
             batch_stats=new_batch_stats,
         )
-        predictions = jnp.argmax(logits, axis=-1)
         metrics = {
             "loss": loss,
-            "correct": jnp.sum(predictions == labels),
-            "count": jnp.asarray(labels.shape[0], jnp.int32),
+            "correct": correct,
+            "count": jnp.asarray(count, jnp.int32),
             "lr": lr,
         }
         return new_state, metrics
 
     # `batch_sh` is a pytree-prefix: every batch leaf (image, label, and
-    # the optional weight mask) shards on its leading dim.
+    # the optional weight mask) shards on its leading dim — or, with
+    # accumulation, on the microbatch dim after the scan axis.
+    if accum_steps == 1:
+        in_batch_sh = batch_sh
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_dp.parallel.dist import DATA_AXIS
+
+        in_batch_sh = NamedSharding(mesh, P(None, DATA_AXIS))
     return jax.jit(
         step,
-        in_shardings=(repl, batch_sh),
+        in_shardings=(repl, in_batch_sh),
         out_shardings=(repl, repl),
         donate_argnums=(0,),
     )

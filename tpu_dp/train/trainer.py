@@ -77,6 +77,7 @@ class Trainer:
             self.train_ds, cfg.data.batch_size, self.mesh,
             shuffle=cfg.data.shuffle, seed=cfg.train.seed,
             drop_remainder=cfg.data.drop_remainder, prefetch=cfg.data.prefetch,
+            accum_steps=cfg.optim.grad_accum_steps,
         )
         self.test_pipe = DataPipeline(
             self.test_ds, cfg.data.batch_size, self.mesh,
@@ -94,6 +95,7 @@ class Trainer:
         self.train_step = make_train_step(
             self.model, self.optimizer, self.mesh, self.schedule,
             use_pallas_xent=cfg.train.pallas_xent,
+            accum_steps=cfg.optim.grad_accum_steps,
         )
         self.eval_step = make_eval_step(self.model, self.mesh)
 
@@ -182,7 +184,8 @@ class Trainer:
     def global_batch_size(self) -> int:
         """Logical per-step batch: per-process batch × processes (the
         reference's batch-4-per-rank × world accounting, SURVEY.md §2A)."""
-        return self.cfg.data.batch_size * self.ctx.process_count
+        return (self.cfg.data.batch_size * self.ctx.process_count
+                * self.cfg.optim.grad_accum_steps)
 
     def train_epoch(self, epoch: int) -> dict[str, float]:
         cfg = self.cfg
