@@ -29,6 +29,7 @@ class DataConfig:
     root: str = "./data"  # reference's `./data` (`cifar_example.py:44`)
     batch_size: int = 4  # per-process; reference parity (`cifar_example.py:42`)
     shuffle: bool = True
+    augment: bool = False  # on-device random crop+flip (reference has none)
     drop_remainder: bool = True
     prefetch: int = 2  # replaces num_workers=2 (`cifar_example.py:47`)
     synthetic_train_size: int | None = None
@@ -53,6 +54,7 @@ class TrainConfig:
     log_every: int = 2000  # `cifar_example.py:84`
     seed: int = 0
     eval_at_end: bool = True
+    eval_every_epochs: int = 0  # 0 = only at end
     ckpt_dir: str = "./checkpoints"
     resume: bool = False
     profile_dir: str | None = None  # enable jax.profiler traces when set
@@ -120,6 +122,7 @@ def _preset_resnet18_cifar10() -> Config:
     c.data.batch_size = 128
     c.optim = OptimConfig(lr=0.1, momentum=0.9, weight_decay=5e-4,
                           schedule="cosine", warmup_epochs=1.0)
+    c.data.augment = True  # needed for the 93% top-1 north star
     c.train.epochs = 30
     return c
 

@@ -62,6 +62,7 @@ def initialize(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    initialization_timeout: int | None = None,
 ) -> DistContext:
     """Bootstrap multi-host JAX if requested; always return the topology.
 
@@ -86,11 +87,25 @@ def initialize(
         num_processes is None or num_processes > 1
     )
     if want_multiprocess and not _initialized_distributed:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+        # Failure detection (SURVEY.md §5 — absent in the reference, whose
+        # init_process_group has no timeout): a bounded rendezvous that
+        # surfaces which coordinator was unreachable instead of hanging.
+        kwargs = {}
+        if initialization_timeout is not None:
+            kwargs["initialization_timeout"] = initialization_timeout
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+        except Exception as e:
+            raise RuntimeError(
+                f"distributed bootstrap failed (coordinator "
+                f"{coordinator_address}, process {process_id}/"
+                f"{num_processes}): {e}"
+            ) from e
         _initialized_distributed = True
 
     return DistContext(

@@ -74,6 +74,7 @@ def make_train_step(
     schedule: Schedule,
     use_pallas_xent: bool = False,
     accum_steps: int = 1,
+    augment_fn: Callable | None = None,
 ) -> Callable:
     """Build the jitted DP train step for this model/optimizer/mesh.
 
@@ -108,6 +109,16 @@ def make_train_step(
 
     def step(state: TrainState, batch):
         images, labels = batch["image"], batch["label"]
+        if augment_fn is not None:
+            # On-device augmentation keyed by the global step (and the
+            # microbatch index under accumulation): compiled into the step,
+            # deterministic, identical on every replica.
+            if accum_steps == 1:
+                images = augment_fn(state.step, images)
+            else:
+                images = jax.vmap(
+                    lambda i, im: augment_fn(state.step * accum_steps + i, im)
+                )(jnp.arange(accum_steps), images)
         if accum_steps == 1:
             loss, grads, new_batch_stats, correct = _forward_backward(
                 state, images, labels

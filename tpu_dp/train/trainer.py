@@ -92,10 +92,16 @@ class Trainer:
             cfg.optim.schedule, cfg.optim.lr, total_steps,
             int(cfg.optim.warmup_epochs * steps_per_epoch), cfg.optim.final_lr,
         )
+        augment_fn = None
+        if cfg.data.augment:
+            from tpu_dp.data.augment import make_augment_fn
+
+            augment_fn = make_augment_fn(cfg.train.seed + 1)
         self.train_step = make_train_step(
             self.model, self.optimizer, self.mesh, self.schedule,
             use_pallas_xent=cfg.train.pallas_xent,
             accum_steps=cfg.optim.grad_accum_steps,
+            augment_fn=augment_fn,
         )
         self.eval_step = make_eval_step(self.model, self.mesh)
 
@@ -252,6 +258,11 @@ class Trainer:
                     {"epoch": epoch, "config": cfg.to_dict(),
                      "seed": cfg.train.seed},
                 )
+                every = cfg.train.eval_every_epochs
+                if every and (epoch + 1) % every == 0:
+                    ev = self.evaluate()
+                    log0("epoch %d: eval loss %.4f acc %.4f",
+                         epoch + 1, ev["loss"], ev["accuracy"])
         print0("Finished Training")  # `cifar_example.py:90` parity
         wall = time.perf_counter() - t0
 
