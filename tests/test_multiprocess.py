@@ -109,3 +109,39 @@ def test_two_process_dp_train_step(tmp_path):
     assert results[0]["param_digest"] == pytest.approx(
         results[1]["param_digest"], rel=1e-6
     )
+
+
+@pytest.mark.slow
+def test_unreachable_coordinator_fails_fast(tmp_path):
+    """Failure detection: a dead coordinator surfaces a contextual error
+    within the timeout instead of hanging (SURVEY.md §5 — the reference's
+    init_process_group has no timeout)."""
+    script = tmp_path / "fail.py"
+    # Note: jax's coordination client aborts the process (LOG(FATAL)) on
+    # rendezvous timeout rather than raising, so "surfacing" here means a
+    # bounded, diagnosable exit — not a Python exception.
+    script.write_text(
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from tpu_dp.parallel import dist\n"
+        "dist.initialize('127.0.0.1:1', num_processes=2, process_id=1,\n"
+        "                initialization_timeout=5)\n"
+    )
+    repo_root = Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        f"{repo_root}{os.pathsep}{env['PYTHONPATH']}"
+        if env.get("PYTHONPATH") else str(repo_root)
+    )
+    import time
+
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, str(script)], cwd=repo_root, env=env,
+        capture_output=True, timeout=120, text=True,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode != 0  # died, did not hang
+    assert elapsed < 90  # bounded by the timeout, not indefinite
+    # Diagnosable: the coordination error names the failure class.
+    assert "DEADLINE_EXCEEDED" in (proc.stdout + proc.stderr)

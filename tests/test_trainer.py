@@ -100,3 +100,15 @@ def test_bf16_and_cosine_run(tmp_path):
     cfg.train.epochs = 1
     result = Trainer(cfg).fit()
     assert np.isfinite(result["history"][0]["loss"])
+
+
+def test_metrics_jsonl_written(tmp_path):
+    import json
+
+    trainer = Trainer(_tiny_cfg(tmp_path))
+    trainer.fit()
+    lines = (tmp_path / "ck" / "metrics.jsonl").read_text().splitlines()
+    records = [json.loads(l) for l in lines]
+    epochs = [r["epoch"] for r in records if "epoch" in r]
+    assert epochs == [1, 2]
+    assert any("eval" in r for r in records)

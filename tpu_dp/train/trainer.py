@@ -20,7 +20,9 @@ step, `cifar_example.py:83`).
 
 from __future__ import annotations
 
+import json
 import time
+from pathlib import Path
 from typing import Any
 
 import jax
@@ -225,6 +227,19 @@ class Trainer:
         self.meter.mark()  # fence: epoch stats fetched, device drained
         return stats
 
+    def _log_metrics(self, record: dict) -> None:
+        """Append a JSON line to <ckpt_dir>/metrics.jsonl (process 0 only).
+
+        Structured observability the reference lacks (its only records are
+        stdout prints, SURVEY.md §5 "Metrics / logging").
+        """
+        if self.ctx.process_index != 0:
+            return
+        path = Path(self.cfg.train.ckpt_dir) / "metrics.jsonl"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
     def evaluate(self) -> dict[str, float]:
         acc = Accuracy()
         loss = Mean()
@@ -253,6 +268,9 @@ class Trainer:
                 log0("epoch %d: train loss %.4f acc %.4f (%.1f img/s)",
                      epoch + 1, stats["loss"], stats["accuracy"],
                      self.meter.images_per_sec)
+                self._log_metrics({"epoch": epoch + 1, **stats,
+                                   "images_per_sec":
+                                       round(self.meter.images_per_sec, 1)})
                 ckpt_lib.save_checkpoint(
                     cfg.train.ckpt_dir, self.state,
                     {"epoch": epoch, "config": cfg.to_dict(),
@@ -278,6 +296,7 @@ class Trainer:
         if cfg.train.eval_at_end:
             eval_stats = self.evaluate()
             result["eval"] = eval_stats
+            self._log_metrics({"eval": eval_stats})
             # Reference integer-percent print (`cifar_example.py:111-112`).
             print0("Accuracy of the network on the %d test images: %d %%"
                    % (len(self.test_ds), int(100 * eval_stats["accuracy"])))
