@@ -54,6 +54,18 @@ def cross_entropy_loss(
     return jnp.sum(per_example * weight) / jnp.maximum(jnp.sum(weight), 1.0)
 
 
+def _maybe_normalize(images: jnp.ndarray) -> jnp.ndarray:
+    """Fused on-device normalize for uint8 batches (pipeline default).
+
+    Same transform as `tpu_dp.data.cifar.normalize` (reference parity:
+    ToTensor + Normalize(0.5, 0.5), `cifar_example.py:38-40`); XLA fuses the
+    convert+scale into the consumer of the batch.
+    """
+    if images.dtype == jnp.uint8:
+        return images.astype(jnp.float32) * (2.0 / 255.0) - 1.0
+    return images
+
+
 def _apply_model(model, state: TrainState, images, train: bool):
     """Run the model, handling BatchNorm's mutable running stats."""
     if state.has_batch_stats:
@@ -108,7 +120,7 @@ def make_train_step(
         return loss, grads, new_batch_stats, correct
 
     def step(state: TrainState, batch):
-        images, labels = batch["image"], batch["label"]
+        images, labels = _maybe_normalize(batch["image"]), batch["label"]
         if augment_fn is not None:
             # On-device augmentation keyed by the global step (and the
             # microbatch index under accumulation): compiled into the step,
@@ -212,7 +224,7 @@ def make_eval_step(model, mesh: Mesh) -> Callable:
     batch_sh = batch_sharding(mesh)
 
     def step(state: TrainState, batch):
-        images, labels = batch["image"], batch["label"]
+        images, labels = _maybe_normalize(batch["image"]), batch["label"]
         weight = batch.get("weight")
         logits, _ = _apply_model(model, state, images, train=False)
         predictions = jnp.argmax(logits, axis=-1)
