@@ -28,15 +28,18 @@ def test_deterministic_in_seed_and_step():
     assert not np.array_equal(np.asarray(a), np.asarray(c))
 
 
-def test_crop_shifts_and_zero_pads():
-    # A constant-1 image: any nonzero shift drags zero padding into view,
-    # so over many samples some outputs must contain zeros while the
-    # centre pixel region stays 1.
+def test_crop_shifts_and_pads_black():
+    # A constant-1 image: any nonzero shift drags padding into view. The
+    # pad value is -1 — black in the step's [-1, 1]-normalized pixel space,
+    # matching torchvision RandomCrop's zero-pad *before* Normalize.
     aug = make_augment_fn(0)
     images = jnp.ones((64, 32, 32, 3), jnp.float32)
     out = np.asarray(aug(jnp.int32(0), images))
-    assert (out == 0).any()  # padding visible on shifted images
+    assert (out == -1).any()  # padding visible on shifted images
     assert (out == 1).sum() > out.size * 0.5  # mostly original content
+    # Raw-pixel-space use keeps the zero-pad default.
+    raw = np.asarray(random_crop_flip(jax.random.PRNGKey(0), images))
+    assert ((raw == 0) | (raw == 1)).all()
 
 
 def test_augmented_training_still_learns(mesh8):

@@ -85,8 +85,6 @@ def test_cifar10_pickle_format_roundtrip(tmp_path):
 
 def test_device_normalize_equals_host_normalize(mesh8):
     """uint8-to-device + in-step normalize ≡ host normalize (same training)."""
-    import jax
-
     from tpu_dp.models import Net
     from tpu_dp.train import SGD, constant_lr, create_train_state, make_train_step
 
@@ -112,3 +110,32 @@ def test_device_normalize_equals_host_normalize(mesh8):
         jax.tree_util.tree_leaves(s_f32.params),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_partial_batch_weight_mask(mesh8):
+    """Eval pipeline: final partial batch is padded with a zeroing mask."""
+    ds = make_synthetic(40, 10, seed=4, name="pw")
+    pipe = DataPipeline(ds, 32, mesh8, shuffle=False, drop_remainder=False,
+                        prefetch=0)
+    batches = list(pipe)
+    assert len(batches) == 2
+    assert "weight" not in batches[0]
+    w = np.asarray(batches[1]["weight"])
+    assert batches[1]["image"].shape == (32, 32, 32, 3)
+    assert w.sum() == 8 and (w[:8] == 1).all() and (w[8:] == 0).all()
+
+
+def test_partial_batch_pad_exceeding_shard(mesh8):
+    """Pad larger than the shard itself must tile the shard (np.resize)."""
+    ds = make_synthetic(8, 10, seed=5, name="tiny")
+    pipe = DataPipeline(ds, 24, mesh8, shuffle=False, drop_remainder=False,
+                        prefetch=0)
+    (b,) = list(pipe)
+    assert b["image"].shape == (24, 32, 32, 3)
+    assert np.asarray(b["weight"]).sum() == 8
+
+
+def test_accum_requires_drop_remainder(mesh8):
+    ds = make_synthetic(64, 10, seed=6, name="ar")
+    with pytest.raises(ValueError, match="drop_remainder"):
+        DataPipeline(ds, 16, mesh8, accum_steps=2, drop_remainder=False)

@@ -96,3 +96,15 @@ def test_matches_torch_distributed_sampler_contract():
             # then rank::world stride. (Shuffled orders differ by RNG, which
             # is fine — the *contract* under test is pad+stride.)
             assert list(ts) == ours.shard_indices().tolist()
+
+
+def test_pad_exceeding_dataset_size_keeps_shards_equal():
+    """More shards than examples: wraparound must tile, not underfill —
+    unequal shard lengths would desync SPMD step counts (deadlock)."""
+    n, world = 2, 8
+    shards = [
+        ShardedSampler(n, world, r, shuffle=False).shard_indices()
+        for r in range(world)
+    ]
+    assert all(len(s) == 1 for s in shards)
+    assert set(np.concatenate(shards).tolist()) == {0, 1}

@@ -1,0 +1,63 @@
+"""On-device data augmentation, compiled into the train step.
+
+The reference has no augmentation (its transform is ToTensor+Normalize only,
+`/root/reference/cifar_example.py:38-40`), but BASELINE.json's 93% top-1
+north star needs the standard CIFAR recipe: pad-4 random crop + horizontal
+flip. TPU-first design: instead of host-side per-example transforms (which
+would serialize on the single host core), the augmentation is a pure jax
+function of ``(step, images)`` executed *on device inside the compiled train
+step* — keyed by the global step counter, so it is deterministic, replayable
+from a checkpoint, and bitwise-identical on every replica (each device
+augments only its own shard; the vmapped per-example keys are derived from
+the global step, not from device identity).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def random_crop_flip(
+    rng: jax.Array, images: jnp.ndarray, pad: int = 4, fill: float = 0.0
+) -> jnp.ndarray:
+    """Pad-`pad` constant-pad random crop + random horizontal flip, per image.
+
+    Shape- and dtype-preserving; NHWC. ``fill`` is the pad value: 0 for raw
+    pixel space, -1 for [-1, 1]-normalized inputs (black in both cases).
+    """
+    n, h, w, _ = images.shape
+    k_off, k_flip = jax.random.split(rng)
+    padded = jnp.pad(
+        images, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+        constant_values=fill,
+    )
+    offsets = jax.random.randint(k_off, (n, 2), 0, 2 * pad + 1)
+    flips = jax.random.bernoulli(k_flip, 0.5, (n,))
+
+    def one(img, off, flip):
+        crop = jax.lax.dynamic_slice(
+            img, (off[0], off[1], 0), (h, w, img.shape[-1])
+        )
+        return jnp.where(flip, crop[:, ::-1, :], crop)
+
+    return jax.vmap(one)(padded, offsets, flips)
+
+
+def make_augment_fn(seed: int, fill: float = -1.0):
+    """Build ``aug(step, images)``: deterministic in (seed, step).
+
+    The train step calls it with the global step counter (and the microbatch
+    index under gradient accumulation), so every optimizer step sees fresh —
+    but reproducible — crops/flips. The step augments *after* its on-device
+    normalize, so the default ``fill`` of -1 reproduces the standard recipe
+    (torchvision RandomCrop pads black *before* Normalize).
+    """
+    base = jax.random.PRNGKey(seed)
+
+    def aug(step, images: jnp.ndarray) -> jnp.ndarray:
+        return random_crop_flip(
+            jax.random.fold_in(base, step), images, fill=fill
+        )
+
+    return aug

@@ -62,7 +62,9 @@ def _maybe_normalize(images: jnp.ndarray) -> jnp.ndarray:
     convert+scale into the consumer of the batch.
     """
     if images.dtype == jnp.uint8:
-        return images.astype(jnp.float32) * (2.0 / 255.0) - 1.0
+        from tpu_dp.data.cifar import normalize
+
+        return normalize(images)  # works on traced arrays; one source of truth
     return images
 
 
