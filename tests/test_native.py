@@ -71,11 +71,66 @@ def test_ring_allreduce_multiprocess(world):
         )
 
 
+def _bcast_gather_worker(rank, world, base_port, conn):
+    try:
+        from tpu_dp.ops.native.hostlib import Ring
+
+        with Ring("127.0.0.1", base_port, rank, world, timeout_ms=20_000) as ring:
+            # Broadcast: >1 pipeline chunk (256 KiB) to exercise the
+            # store-and-forward overlap; int64 to prove byte-typed transport.
+            payload = (
+                np.arange(100_003, dtype=np.int64)
+                if rank == 1
+                else np.zeros(100_003, dtype=np.int64)
+            )
+            bcast = ring.broadcast(payload, root=1)
+            gathered = ring.allgather(
+                np.full((3, 5), float(rank), dtype=np.float32)
+            )
+            ring.barrier()
+        conn.send(pickle.dumps((rank, bcast, gathered)))
+    except BaseException as e:
+        conn.send(pickle.dumps(e))
+    finally:
+        conn.close()
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_ring_broadcast_allgather_multiprocess(world):
+    ctx = mp.get_context("spawn")
+    base_port = 23700 + world * 16
+    pipes, procs = [], []
+    for rank in range(world):
+        parent, child = ctx.Pipe()
+        p = ctx.Process(
+            target=_bcast_gather_worker, args=(rank, world, base_port, child)
+        )
+        p.start()
+        pipes.append(parent)
+        procs.append(p)
+    expected_bcast = np.arange(100_003, dtype=np.int64)
+    expected_gather = np.stack(
+        [np.full((3, 5), float(r), dtype=np.float32) for r in range(world)]
+    )
+    for parent, p in zip(pipes, procs):
+        payload = pickle.loads(parent.recv())
+        p.join(timeout=30)
+        if isinstance(payload, BaseException):
+            raise payload
+        _, bcast, gathered = payload
+        np.testing.assert_array_equal(bcast, expected_bcast)
+        np.testing.assert_array_equal(gathered, expected_gather)
+
+
 def test_ring_world_one_is_identity():
     from tpu_dp.ops.native.hostlib import Ring
 
     data = np.arange(5, dtype=np.float32)
     with Ring("127.0.0.1", 23900, 0, 1) as ring:
         out = ring.allreduce(data.copy(), op="mean")
+        bcast = ring.broadcast(data.copy())
+        gathered = ring.allgather(data)
         ring.barrier()
     np.testing.assert_array_equal(out, data)
+    np.testing.assert_array_equal(bcast, data)
+    np.testing.assert_array_equal(gathered, data[None])

@@ -6,10 +6,12 @@ next to the source). Provides:
 - topology introspection (`cpu_count`, `hostname`) — the host-side analogue
   of the reference's device pinning info (`torch.cuda.set_device`,
   `/root/reference/cifar_example_ddp.py:53`);
-- a TCP ring allreduce + barrier across processes — a Gloo-style fallback
-  backing host-level collective semantics when no XLA mesh is available
-  (parity with the reference's NCCL layer per SURVEY.md §2B row 1; the TPU
-  path stays XLA-lowered and never uses this).
+- TCP ring collectives across processes — allreduce(sum/mean), broadcast
+  (DDP's rank-0 param replication, `cifar_example_ddp.py:83`), all-gather,
+  and barrier — a Gloo-style fallback backing host-level collective
+  semantics when no XLA mesh is available (parity with the reference's NCCL
+  primitive set per SURVEY.md §2B row 1; the TPU path stays XLA-lowered and
+  never uses this).
 
 If the toolchain is unavailable the import still succeeds; `available()`
 returns False and pure-Python fallbacks are used.

@@ -59,6 +59,14 @@ def _get() -> ctypes.CDLL | None:
             ctypes.c_int,
         ]
         lib.tpudp_ring_allreduce.restype = ctypes.c_int
+        lib.tpudp_ring_broadcast.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+        ]
+        lib.tpudp_ring_broadcast.restype = ctypes.c_int
+        lib.tpudp_ring_allgather.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.tpudp_ring_allgather.restype = ctypes.c_int
         lib.tpudp_ring_barrier.argtypes = [ctypes.c_void_p]
         lib.tpudp_ring_barrier.restype = ctypes.c_int
         lib.tpudp_ring_destroy.argtypes = [ctypes.c_void_p]
@@ -125,6 +133,39 @@ class Ring:
         if rc != 0:
             raise RuntimeError("ring allreduce failed")
         return arr
+
+    def broadcast(self, array: np.ndarray, root: int = 0) -> np.ndarray:
+        """In-place byte broadcast from `root` to all ranks (any dtype).
+
+        Host-side analogue of DDP's rank-0 param replication at wrap time
+        (`/root/reference/cifar_example_ddp.py:83`): non-root contents are
+        overwritten with root's.
+        """
+        arr = np.ascontiguousarray(array)
+        if self.world == 1:
+            return arr
+        rc = self._lib.tpudp_ring_broadcast(
+            self._ctx, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes, root
+        )
+        if rc != 0:
+            raise RuntimeError("ring broadcast failed")
+        if isinstance(array, np.ndarray) and arr is not array:
+            array[...] = arr  # ascontiguousarray copied; honor in-place
+        return arr
+
+    def allgather(self, array: np.ndarray) -> np.ndarray:
+        """Gather equal-shape per-rank arrays; returns (world, *shape)."""
+        arr = np.ascontiguousarray(array)
+        out = np.empty((self.world,) + arr.shape, dtype=arr.dtype)
+        out[self.rank] = arr
+        if self.world == 1:
+            return out
+        rc = self._lib.tpudp_ring_allgather(
+            self._ctx, out.ctypes.data_as(ctypes.c_void_p), arr.nbytes
+        )
+        if rc != 0:
+            raise RuntimeError("ring allgather failed")
+        return out
 
     def barrier(self) -> None:
         if self._lib.tpudp_ring_barrier(self._ctx) != 0:

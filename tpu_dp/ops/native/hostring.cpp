@@ -190,6 +190,68 @@ int tpudp_ring_allreduce(void* vctx, float* data, int64_t n, int op) {
   return 0;
 }
 
+// Ring-pipelined broadcast of raw bytes from `root` to all ranks.
+// The host-side analogue of DDP's param broadcast at wrap time
+// (the reference's DistributedDataParallel(...) replicates rank-0 weights,
+// /root/reference/cifar_example_ddp.py:83). Store-and-forward per chunk,
+// with the forward of chunk i overlapped with the receive of chunk i+1,
+// so every link is busy once the pipeline fills.
+int tpudp_ring_broadcast(void* vctx, char* data, int64_t nbytes, int root) {
+  RingCtx* ctx = (RingCtx*)vctx;
+  if (!ctx || nbytes < 0 || root < 0 || root >= ctx->world) return -1;
+  int world = ctx->world;
+  if (world == 1 || nbytes == 0) return 0;
+  int pos = ((ctx->rank - root) % world + world) % world;
+
+  const int64_t kChunk = 1 << 18;  // 256 KiB: fills the pipe, bounds latency
+  std::thread sender;
+  int send_rc = 0;
+  for (int64_t off = 0; off < nbytes; off += kChunk) {
+    int64_t len = nbytes - off < kChunk ? nbytes - off : kChunk;
+    if (pos > 0 && read_full(ctx->prev_fd, data + off, (size_t)len) != 0) {
+      if (sender.joinable()) sender.join();
+      return -1;
+    }
+    if (pos < world - 1) {
+      if (sender.joinable()) {
+        sender.join();
+        if (send_rc != 0) return -1;
+      }
+      char* p = data + off;
+      sender = std::thread(
+          [ctx, p, len, &send_rc]() { send_rc = write_full(ctx->next_fd, p, (size_t)len); });
+    }
+  }
+  if (sender.joinable()) sender.join();
+  return send_rc;
+}
+
+// Ring all-gather of equal-size byte segments. `data` holds world segments
+// of seg_bytes each; this rank's own segment is pre-filled at index `rank`.
+// n-1 steps, send/recv overlapped — the all-gather half of the wire-optimal
+// allreduce schedule, exposed standalone (NCCL primitive parity).
+int tpudp_ring_allgather(void* vctx, char* data, int64_t seg_bytes) {
+  RingCtx* ctx = (RingCtx*)vctx;
+  if (!ctx || seg_bytes < 0) return -1;
+  int world = ctx->world, rank = ctx->rank;
+  if (world == 1 || seg_bytes == 0) return 0;
+
+  for (int s = 0; s < world - 1; ++s) {
+    int send_c = ((rank - s) % world + world) % world;
+    int recv_c = ((rank - s - 1) % world + world) % world;
+    const char* sp = data + (int64_t)send_c * seg_bytes;
+    int send_rc = 0;
+    std::thread sender([&]() {
+      send_rc = write_full(ctx->next_fd, sp, (size_t)seg_bytes);
+    });
+    int recv_rc = read_full(ctx->prev_fd, data + (int64_t)recv_c * seg_bytes,
+                            (size_t)seg_bytes);
+    sender.join();
+    if (send_rc != 0 || recv_rc != 0) return -1;
+  }
+  return 0;
+}
+
 int tpudp_ring_barrier(void* vctx) {
   float x = 1.0f;
   RingCtx* ctx = (RingCtx*)vctx;
