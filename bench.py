@@ -33,7 +33,7 @@ V100_BASELINE_IMG_PER_SEC_PER_CHIP = 2500.0
 
 WARMUP_STEPS = 5
 MEASURE_STEPS = 30
-PER_CHIP_BATCH = 1024
+PER_CHIP_BATCH = 2048
 
 
 def main() -> None:

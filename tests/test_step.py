@@ -80,6 +80,66 @@ def test_multi_step_trajectory_equivalence(setup, mesh8, mesh1, rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+def test_shard_map_matches_gspmd(setup, mesh8, rng):
+    """Explicit-collectives path ≡ GSPMD-inferred path, step for step.
+
+    Two statements of the same distributed program — per-shard grads +
+    explicit `lax.pmean` vs sharding annotations + inferred all-reduce —
+    must produce identical losses, counts, and parameter trajectories.
+    """
+    from tpu_dp.train import make_train_step_shard_map
+
+    model, opt, state = setup
+    step_g = make_train_step(model, opt, mesh8, constant_lr(0.05))
+    step_s = make_train_step_shard_map(model, opt, mesh8, constant_lr(0.05))
+    sg, ss = _copy(state), _copy(state)
+    for i in range(3):
+        batch = _make_batch(np.random.default_rng(i), 16)
+        sg, mg = step_g(sg, batch)
+        ss, ms = step_s(ss, batch)
+        np.testing.assert_allclose(
+            float(mg["loss"]), float(ms["loss"]), rtol=1e-5
+        )
+        assert int(mg["correct"]) == int(ms["correct"])
+        assert int(mg["count"]) == int(ms["count"])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(sg.params), jax.tree_util.tree_leaves(ss.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_shard_map_sync_bn_resnet(mesh8, rng):
+    """shard_map path with a BatchNorm model (axis_name-synced stats)."""
+    from tpu_dp.models import ResNet18
+    from tpu_dp.parallel.dist import DATA_AXIS
+    from tpu_dp.train import make_train_step_shard_map
+
+    model_s = ResNet18(num_classes=10, num_filters=8, axis_name=DATA_AXIS)
+    model_g = ResNet18(num_classes=10, num_filters=8)
+    opt = SGD(momentum=0.9)
+    state = create_train_state(
+        model_g, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32), opt
+    )
+    step_g = make_train_step(model_g, opt, mesh8, constant_lr(0.05))
+    step_s = make_train_step_shard_map(model_s, opt, mesh8, constant_lr(0.05))
+    sg, ss = _copy(state), _copy(state)
+    batch = _make_batch(rng, 16)
+    sg, mg = step_g(sg, batch)
+    ss, ms = step_s(ss, batch)
+    np.testing.assert_allclose(float(mg["loss"]), float(ms["loss"]), rtol=1e-5)
+    # Global-batch BN statistics: running stats from per-shard stats synced
+    # over the data axis must match GSPMD's global-batch computation.
+    for a, b in zip(
+        jax.tree_util.tree_leaves(sg.batch_stats),
+        jax.tree_util.tree_leaves(ss.batch_stats),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(sg.params), jax.tree_util.tree_leaves(ss.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
 def test_loss_decreases(setup, mesh8, rng):
     """The reference's in-band signal: running loss goes down."""
     model, opt, state = setup

@@ -80,10 +80,12 @@ def make_synthetic(
     "loss decreases" signal the reference prints
     (`/root/reference/cifar_example.py:84-87`) without real data.
 
-    Templates depend only on ``seed``; ``example_seed`` (default: ``seed``)
-    draws labels/noise. Train/test splits of one synthetic "dataset" share
-    ``seed`` (same classes — the test set is learnable from the train set)
-    but use distinct example seeds (disjoint draws).
+    Templates depend only on ``seed``. Labels/noise are drawn from a fresh
+    ``example_seed`` stream when given; when ``example_seed`` is None they
+    continue the template RNG stream (so the default is NOT equivalent to
+    ``example_seed=seed``). Train/test splits of one synthetic "dataset"
+    share ``seed`` (same classes — the test set is learnable from the train
+    set) but use distinct example seeds (disjoint draws).
     """
     rng = np.random.default_rng(seed)
     templates = rng.integers(

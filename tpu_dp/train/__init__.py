@@ -14,6 +14,7 @@ from tpu_dp.train.step import (
     cross_entropy_loss,
     make_eval_step,
     make_train_step,
+    make_train_step_shard_map,
 )
 from tpu_dp.train.trainer import Trainer
 
@@ -29,4 +30,5 @@ __all__ = [
     "make_eval_step",
     "make_schedule",
     "make_train_step",
+    "make_train_step_shard_map",
 ]

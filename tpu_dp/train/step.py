@@ -81,6 +81,45 @@ def _apply_model(model, state: TrainState, images, train: bool):
     return model.apply({"params": state.params}, images, train=train), {}
 
 
+def _forward_backward(model, loss_impl, state: TrainState, images, labels):
+    """Shared fwd+bwd block: loss, grads, updated BN stats, correct count.
+
+    Train batches are always full (drop_remainder enforced), so no weight
+    mask on the training loss. Used by both step factories so the GSPMD and
+    explicit-`shard_map` paths cannot drift apart.
+    """
+
+    def loss_fn(params):
+        logits, new_batch_stats = _apply_model(
+            model, state.replace(params=params), images, train=True
+        )
+        return loss_impl(logits, labels), (logits, new_batch_stats)
+
+    (loss, (logits, new_batch_stats)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True
+    )(state.params)
+    correct = jnp.sum(jnp.argmax(logits, axis=-1) == labels)
+    return loss, grads, new_batch_stats, correct
+
+
+def _apply_update(
+    optimizer: Optimizer, schedule: Schedule, state: TrainState, grads,
+    new_batch_stats,
+):
+    """Shared optimizer tail: LR lookup, update, next TrainState."""
+    lr = schedule(state.step)
+    new_params, new_opt_state = optimizer.update(
+        grads, state.opt_state, state.params, lr
+    )
+    new_state = TrainState(
+        step=state.step + 1,
+        params=new_params,
+        opt_state=new_opt_state,
+        batch_stats=new_batch_stats,
+    )
+    return new_state, lr
+
+
 def make_train_step(
     model,
     optimizer: Optimizer,
@@ -106,21 +145,6 @@ def make_train_step(
     else:
         loss_impl = cross_entropy_loss
 
-    def _forward_backward(state: TrainState, images, labels):
-        def loss_fn(params):
-            logits, new_batch_stats = _apply_model(
-                model, state.replace(params=params), images, train=True
-            )
-            # Train batches are always full (drop_remainder enforced), so no
-            # weight mask on the training loss.
-            return loss_impl(logits, labels), (logits, new_batch_stats)
-
-        (loss, (logits, new_batch_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
-        correct = jnp.sum(jnp.argmax(logits, axis=-1) == labels)
-        return loss, grads, new_batch_stats, correct
-
     def step(state: TrainState, batch):
         images, labels = _maybe_normalize(batch["image"]), batch["label"]
         if augment_fn is not None:
@@ -135,7 +159,7 @@ def make_train_step(
                 )(jnp.arange(accum_steps), images)
         if accum_steps == 1:
             loss, grads, new_batch_stats, correct = _forward_backward(
-                state, images, labels
+                model, loss_impl, state, images, labels
             )
             count = labels.shape[0]
         else:
@@ -149,7 +173,7 @@ def make_train_step(
                 grads_acc, batch_stats, loss_acc, correct_acc = carry
                 mstate = state.replace(batch_stats=batch_stats)
                 loss, grads, new_bs, correct = _forward_backward(
-                    mstate, mb["image"], mb["label"]
+                    model, loss_impl, mstate, mb["image"], mb["label"]
                 )
                 grads_acc = jax.tree_util.tree_map(
                     jnp.add, grads_acc, grads
@@ -172,15 +196,8 @@ def make_train_step(
             loss = loss_sum / accum_steps
             count = labels.shape[0] * labels.shape[1]
 
-        lr = schedule(state.step)
-        new_params, new_opt_state = optimizer.update(
-            grads, state.opt_state, state.params, lr
-        )
-        new_state = TrainState(
-            step=state.step + 1,
-            params=new_params,
-            opt_state=new_opt_state,
-            batch_stats=new_batch_stats,
+        new_state, lr = _apply_update(
+            optimizer, schedule, state, grads, new_batch_stats
         )
         metrics = {
             "loss": loss,
@@ -204,6 +221,82 @@ def make_train_step(
     return jax.jit(
         step,
         in_shardings=(repl, in_batch_sh),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+
+
+def make_train_step_shard_map(
+    model,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    schedule: Schedule,
+) -> Callable:
+    """Explicit-collectives variant of the DP train step (`shard_map`).
+
+    Where `make_train_step` lets GSPMD *infer* the gradient all-reduce from
+    sharding annotations, this path writes the distributed program per-shard,
+    with the collectives explicit: each device computes loss/grads over its
+    local shard of the global batch, then `lax.pmean`s the gradients over the
+    ``data`` mesh axis (ICI) — a line-for-line statement of what DDP's C++
+    reducer does from backward hooks (`/root/reference/cifar_example_ddp.py:83`),
+    but inside one compiled program. Both paths are equivalence-tested against
+    each other; this one is also the extension point for hand-scheduled
+    comms (e.g. overlapping grad reduction with remaining backward compute).
+
+    BatchNorm models must be constructed with ``axis_name=DATA_AXIS`` so
+    batch statistics sync across shards (the `shard_map` analogue of the
+    global-batch stats GSPMD computes automatically — sync-BN semantics).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dp.parallel import collectives
+    from tpu_dp.parallel.dist import DATA_AXIS
+
+    repl = replicated_sharding(mesh)
+    batch_sh = batch_sharding(mesh)
+    repl_spec = P()
+    batch_spec = P(DATA_AXIS)
+    world = int(mesh.devices.size)
+
+    def local_step(state: TrainState, batch):
+        images, labels = _maybe_normalize(batch["image"]), batch["label"]
+        loss, grads, new_batch_stats, correct = _forward_backward(
+            model, cross_entropy_loss, state, images, labels
+        )
+
+        # The explicit DDP all-reduce: grad mean over the data axis.
+        grads = collectives.pmean(grads)
+        loss = jax.lax.pmean(loss, DATA_AXIS)
+        correct = jax.lax.psum(correct, DATA_AXIS)
+        if getattr(model, "axis_name", None) is None:
+            # Unsynced BN model: average per-shard running stats so state
+            # leaves shard_map replicated. Models built with
+            # axis_name=DATA_AXIS already synced in-forward — skip the
+            # redundant per-step all-reduce over the stats tree.
+            new_batch_stats = collectives.pmean(new_batch_stats)
+
+        new_state, lr = _apply_update(
+            optimizer, schedule, state, grads, new_batch_stats
+        )
+        metrics = {
+            "loss": loss,
+            "correct": correct,
+            "count": jnp.asarray(labels.shape[0] * world, jnp.int32),
+            "lr": lr,
+        }
+        return new_state, metrics
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(repl_spec, batch_spec),
+        out_specs=(repl_spec, repl_spec),
+        check_vma=False,
+    )
+    return jax.jit(
+        sharded,
+        in_shardings=(repl, batch_sh),
         out_shardings=(repl, repl),
         donate_argnums=(0,),
     )
