@@ -122,6 +122,82 @@ def test_ring_broadcast_allgather_multiprocess(world):
         np.testing.assert_array_equal(gathered, expected_gather)
 
 
+def _primitive_worker(rank, world, base_port, conn):
+    try:
+        from tpu_dp.ops.native.hostlib import Ring
+
+        rng = np.random.default_rng(100 + rank)
+        # >1 pipeline chunk (65536 floats) so reduce exercises the chunked path.
+        contrib = rng.normal(size=70_001).astype(np.float32)
+        rs_in = np.stack(
+            [np.full(37, 10.0 * rank + seg, np.float32) for seg in range(world)]
+        )
+        rs_in_orig = rs_in.copy()
+        with Ring("127.0.0.1", base_port, rank, world, timeout_ms=20_000) as ring:
+            reduced = ring.reduce(contrib.copy(), root=1, op="sum")
+            seg = ring.reduce_scatter(rs_in, op="sum")
+            assert np.array_equal(rs_in, rs_in_orig), "sendbuf must stay const"
+            # p2p: everyone sends its rank id forward, receives prev's.
+            # (Small payload — symmetric ungrouped send/recv is rendezvous-
+            # blocking beyond socket buffering; large symmetric exchanges
+            # go through ring.exchange below.)
+            ring.send_next(np.array([rank], np.int32))
+            from_prev = ring.recv_prev((1,), np.int32)
+            shifted = ring.shift(np.array([float(rank)], np.float32), k=1)
+            # Grouped sendrecv at 4 MB/rank: overlapped, must not deadlock.
+            big = np.full(1_000_000, float(rank), np.float32)
+            exchanged = ring.exchange(big)
+            assert big[0] == float(rank), "exchange must not clobber input"
+            assert np.all(exchanged == float((rank - 1) % world))
+            ring.barrier()
+        conn.send(pickle.dumps((rank, contrib, reduced, seg, from_prev, shifted)))
+    except BaseException as e:
+        conn.send(pickle.dumps(e))
+    finally:
+        conn.close()
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_ring_reduce_scatter_p2p_shift_multiprocess(world):
+    """NCCL primitive-set parity: reduce, reduce-scatter, send/recv, permute."""
+    ctx = mp.get_context("spawn")
+    base_port = 24100 + world * 16
+    pipes, procs = [], []
+    for rank in range(world):
+        parent, child = ctx.Pipe()
+        p = ctx.Process(
+            target=_primitive_worker, args=(rank, world, base_port, child)
+        )
+        p.start()
+        pipes.append(parent)
+        procs.append(p)
+    results = []
+    for parent, p in zip(pipes, procs):
+        # Bounded wait: these primitives are the rendezvous-deadlock-prone
+        # ones — a regression must fail in 2 min, not hang CI.
+        if not parent.poll(120):
+            for q in procs:
+                q.terminate()
+            pytest.fail("p2p worker deadlocked (no result within 120s)")
+        payload = pickle.loads(parent.recv())
+        p.join(timeout=30)
+        if isinstance(payload, BaseException):
+            raise payload
+        results.append(payload)
+
+    total = np.sum([r[1] for r in results], axis=0)
+    for rank, contrib, reduced, seg, from_prev, shifted in results:
+        if rank == 1:  # root holds the reduction...
+            np.testing.assert_allclose(reduced, total, rtol=1e-5, atol=1e-4)
+        else:  # ...everyone else keeps their input (ncclReduce semantics)
+            np.testing.assert_array_equal(reduced, contrib)
+        # reduce_scatter: rank r's segment = sum over ranks of (10*r' + r)
+        expected_seg = np.full(37, sum(10.0 * r + rank for r in range(world)))
+        np.testing.assert_allclose(seg, expected_seg, rtol=1e-6)
+        assert from_prev[0] == (rank - 1) % world
+        assert shifted[0] == float((rank - 1) % world)
+
+
 def test_ring_world_one_is_identity():
     from tpu_dp.ops.native.hostlib import Ring
 
@@ -130,7 +206,19 @@ def test_ring_world_one_is_identity():
         out = ring.allreduce(data.copy(), op="mean")
         bcast = ring.broadcast(data.copy())
         gathered = ring.allgather(data)
+        seg = ring.reduce_scatter(data[None], op="sum")
+        reduced = ring.reduce(data.copy(), root=0, op="sum")
+        shifted = ring.shift(data.copy(), k=1)
+        # self-loop p2p: send_next pairs with our own recv_prev
+        ring.send_next(data)
+        echoed = ring.recv_prev(data.shape, data.dtype)
+        with pytest.raises(RuntimeError):
+            ring.recv_prev(data.shape, data.dtype)  # nothing queued
         ring.barrier()
     np.testing.assert_array_equal(out, data)
     np.testing.assert_array_equal(bcast, data)
     np.testing.assert_array_equal(gathered, data[None])
+    np.testing.assert_array_equal(seg, data)
+    np.testing.assert_array_equal(reduced, data)
+    np.testing.assert_array_equal(shifted, data)
+    np.testing.assert_array_equal(echoed, data)

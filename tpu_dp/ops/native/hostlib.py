@@ -67,6 +67,28 @@ def _get() -> ctypes.CDLL | None:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
         ]
         lib.tpudp_ring_allgather.restype = ctypes.c_int
+        lib.tpudp_ring_reduce_scatter.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+        ]
+        lib.tpudp_ring_reduce_scatter.restype = ctypes.c_int
+        lib.tpudp_ring_reduce.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.tpudp_ring_reduce.restype = ctypes.c_int
+        lib.tpudp_ring_send_next.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.tpudp_ring_send_next.restype = ctypes.c_int
+        lib.tpudp_ring_recv_prev.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.tpudp_ring_recv_prev.restype = ctypes.c_int
+        lib.tpudp_ring_shift.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+        ]
+        lib.tpudp_ring_shift.restype = ctypes.c_int
         lib.tpudp_ring_barrier.argtypes = [ctypes.c_void_p]
         lib.tpudp_ring_barrier.restype = ctypes.c_int
         lib.tpudp_ring_destroy.argtypes = [ctypes.c_void_p]
@@ -166,6 +188,96 @@ class Ring:
         if rc != 0:
             raise RuntimeError("ring allgather failed")
         return out
+
+    def reduce_scatter(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
+        """Reduce `array` (shape (world, *seg)) across ranks; return this
+        rank's reduced segment (shape seg) — ncclReduceScatter semantics."""
+        if array.shape[0] != self.world:
+            raise ValueError(
+                f"reduce_scatter input must have leading dim world={self.world}, "
+                f"got {array.shape}"
+            )
+        # Always copy: the C schedule accumulates into its input buffer, and
+        # NCCL's sendbuff is const — the caller's array must stay intact.
+        arr = np.array(array, dtype=np.float32, order="C", copy=True)
+        seg_shape = arr.shape[1:]
+        out = np.empty(seg_shape, dtype=np.float32)
+        seg_n = int(np.prod(seg_shape, dtype=np.int64)) if seg_shape else 1
+        rc = self._lib.tpudp_ring_reduce_scatter(
+            self._ctx,
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            seg_n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            {"sum": 0, "mean": 1}[op],
+        )
+        if rc != 0:
+            raise RuntimeError("ring reduce_scatter failed")
+        return out
+
+    def reduce(self, array: np.ndarray, root: int = 0,
+               op: str = "sum") -> np.ndarray:
+        """Reduce to `root` (ncclReduce semantics): root's returned array
+        holds the reduction; other ranks get their input back unchanged.
+        The caller's array is never mutated (const sendbuff, as in NCCL)."""
+        arr = np.array(array, dtype=np.float32, order="C", copy=True)
+        rc = self._lib.tpudp_ring_reduce(
+            self._ctx,
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            arr.size,
+            root,
+            {"sum": 0, "mean": 1}[op],
+        )
+        if rc != 0:
+            raise RuntimeError("ring reduce failed")
+        return arr
+
+    def send_next(self, array: np.ndarray) -> None:
+        """Point-to-point: send raw bytes to rank (rank+1) % world. Pair
+        with the receiver's `recv_prev` — the neighbor send/recv every ring
+        schedule is built from.
+
+        Rendezvous-blocking, like an *ungrouped* ncclSend: if every rank
+        calls send_next before recv_prev, payloads beyond the kernel socket
+        buffer deadlock. For the symmetric everyone-sends-everyone-receives
+        pattern use :meth:`exchange` (the grouped sendrecv)."""
+        arr = np.ascontiguousarray(array)
+        rc = self._lib.tpudp_ring_send_next(
+            self._ctx, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes
+        )
+        if rc != 0:
+            raise RuntimeError("ring send_next failed")
+
+    def recv_prev(self, shape, dtype) -> np.ndarray:
+        """Point-to-point: receive an array of `shape`/`dtype` from rank
+        (rank-1) % world."""
+        out = np.empty(shape, dtype=dtype)
+        rc = self._lib.tpudp_ring_recv_prev(
+            self._ctx, out.ctypes.data_as(ctypes.c_void_p), out.nbytes
+        )
+        if rc != 0:
+            raise RuntimeError("ring recv_prev failed")
+        return out
+
+    def exchange(self, array: np.ndarray) -> np.ndarray:
+        """Grouped neighbor sendrecv: send `array` to rank+1 while receiving
+        rank-1's array (send/recv overlapped on a sender thread in C — no
+        socket-buffer deadlock at any payload size). The ncclGroupStart/
+        ncclSend/ncclRecv/ncclGroupEnd pattern for symmetric neighbor p2p;
+        the caller's array is left intact."""
+        return self.shift(np.array(array, order="C", copy=True), k=1)
+
+    def shift(self, array: np.ndarray, k: int = 1) -> np.ndarray:
+        """Collective shift-by-k (host `lax.ppermute` analogue): returns the
+        array that started on rank (rank - k) % world. In place when the
+        input is already contiguous (like :meth:`allreduce`); use
+        :meth:`exchange` for a non-mutating k=1 shift."""
+        arr = np.ascontiguousarray(array)
+        rc = self._lib.tpudp_ring_shift(
+            self._ctx, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes, int(k)
+        )
+        if rc != 0:
+            raise RuntimeError("ring shift failed")
+        return arr
 
     def barrier(self) -> None:
         if self._lib.tpudp_ring_barrier(self._ctx) != 0:

@@ -29,6 +29,7 @@
 
 #include <cerrno>
 #include <cstring>
+#include <deque>
 #include <thread>
 #include <vector>
 
@@ -43,6 +44,10 @@ struct RingCtx {
   int world;
   int next_fd;  // we send to next
   int prev_fd;  // we receive from prev
+  // world==1 self-loop: send_next targets this rank itself, so p2p payloads
+  // queue here instead of a socket (keeps the send/recv pairing an identity
+  // at world 1, like every other primitive).
+  std::deque<std::vector<char>> self_queue;
 };
 
 static int set_nodelay(int fd) {
@@ -248,6 +253,167 @@ int tpudp_ring_allgather(void* vctx, char* data, int64_t seg_bytes) {
                             (size_t)seg_bytes);
     sender.join();
     if (send_rc != 0 || recv_rc != 0) return -1;
+  }
+  return 0;
+}
+
+// Ring reduce-scatter on float32 data (NCCL ncclReduceScatter parity).
+// `data` holds world equal segments of seg_n floats; on return `out`
+// (seg_n floats) holds the fully-reduced segment for this rank's index.
+// Same wire-optimal n-1-step schedule as the allreduce's first half, with
+// chunk indexing shifted by one so rank r finishes owning segment r.
+// op: 0 = sum, 1 = mean.
+int tpudp_ring_reduce_scatter(void* vctx, float* data, int64_t seg_n,
+                              float* out, int op) {
+  RingCtx* ctx = (RingCtx*)vctx;
+  if (!ctx || seg_n < 0) return -1;
+  int world = ctx->world, rank = ctx->rank;
+  if (seg_n == 0) return 0;
+  if (world == 1) {
+    memcpy(out, data, (size_t)seg_n * sizeof(float));
+    return 0;
+  }
+
+  std::vector<float> recv_buf((size_t)seg_n);
+  size_t seg_bytes = (size_t)seg_n * sizeof(float);
+  // At step s: send chunk (r-s-1), receive+accumulate chunk (r-s-2).
+  // After world-1 steps this rank has fully accumulated chunk r.
+  for (int s = 0; s < world - 1; ++s) {
+    int send_c = ((rank - s - 1) % world + world) % world;
+    int recv_c = ((rank - s - 2) % world + world) % world;
+    const char* sp = (const char*)(data + (int64_t)send_c * seg_n);
+    int send_rc = 0;
+    std::thread sender([&]() { send_rc = write_full(ctx->next_fd, sp, seg_bytes); });
+    int recv_rc = read_full(ctx->prev_fd, (char*)recv_buf.data(), seg_bytes);
+    sender.join();
+    if (send_rc != 0 || recv_rc != 0) return -1;
+    float* dst = data + (int64_t)recv_c * seg_n;
+    for (int64_t i = 0; i < seg_n; ++i) dst[i] += recv_buf[i];
+  }
+  memcpy(out, data + (int64_t)rank * seg_n, seg_bytes);
+  if (op == 1) {
+    float inv = 1.0f / (float)world;
+    for (int64_t i = 0; i < seg_n; ++i) out[i] *= inv;
+  }
+  return 0;
+}
+
+// Chain reduce to `root` on float32 data (NCCL ncclReduce parity), pipelined
+// over chunks so every link streams concurrently once the pipe fills. The
+// chain runs ring-forward from root+1 and terminates at root; only root's
+// buffer holds the reduction on return (others' inputs are left intact).
+// op: 0 = sum, 1 = mean (applied at root).
+int tpudp_ring_reduce(void* vctx, float* data, int64_t n, int root, int op) {
+  RingCtx* ctx = (RingCtx*)vctx;
+  if (!ctx || n < 0 || root < 0 || root >= ctx->world) return -1;
+  int world = ctx->world;
+  // world==1: mean of one contribution is the identity — nothing to do.
+  if (world == 1 || n == 0) return 0;
+  // pos 0 = chain head (root+1): sends only. pos world-1 = root: receives
+  // only. Middle ranks receive a partial, add their contribution, forward.
+  int pos = ((ctx->rank - root - 1) % world + world) % world;
+
+  const int64_t kChunk = 1 << 16;  // floats per pipeline stage (256 KiB)
+  // Two alternating scratch buffers mid-chain: chunk i forwards (async)
+  // from one while chunk i+1 is received into the other, so the recv and
+  // the downstream send genuinely overlap at every rank.
+  size_t tmp_n = (size_t)(n < kChunk ? n : kChunk);
+  std::vector<float> tmp_a(tmp_n), tmp_b(tmp_n);
+  std::thread sender;
+  int send_rc = 0;
+  bool use_a = true;
+  for (int64_t off = 0; off < n; off += kChunk) {
+    int64_t len = n - off < kChunk ? n - off : kChunk;
+    float* chunk = data + off;
+    float* tmp = use_a ? tmp_a.data() : tmp_b.data();
+    use_a = !use_a;
+    float* fwd = chunk;  // what we forward: own data at the head, sum mid-chain
+    if (pos > 0) {
+      if (read_full(ctx->prev_fd, (char*)tmp, (size_t)len * sizeof(float)) != 0) {
+        if (sender.joinable()) sender.join();
+        return -1;
+      }
+      if (pos == world - 1) {  // root: accumulate in place
+        for (int64_t i = 0; i < len; ++i) chunk[i] += tmp[i];
+      } else {  // middle: accumulate into tmp, forward tmp (keep own input)
+        for (int64_t i = 0; i < len; ++i) tmp[i] += chunk[i];
+        fwd = tmp;
+      }
+    }
+    if (pos < world - 1) {
+      if (sender.joinable()) {
+        sender.join();
+        if (send_rc != 0) return -1;
+      }
+      sender = std::thread([ctx, fwd, len, &send_rc]() {
+        send_rc = write_full(ctx->next_fd, (const char*)fwd,
+                             (size_t)len * sizeof(float));
+      });
+    }
+  }
+  if (sender.joinable()) sender.join();
+  if (send_rc != 0) return -1;
+  if (op == 1 && pos == world - 1) {
+    float inv = 1.0f / (float)world;
+    for (int64_t i = 0; i < n; ++i) data[i] *= inv;
+  }
+  return 0;
+}
+
+// Neighbor point-to-point (the restricted ncclSend/ncclRecv pair every ring
+// schedule is built from): send raw bytes to the next rank / receive from
+// the previous rank. These are RENDEZVOUS-BLOCKING, like ungrouped
+// ncclSend/ncclRecv: a lone send_next completes only up to kernel socket
+// buffering, so the symmetric all-ranks-send-then-recv pattern deadlocks for
+// payloads beyond ~the socket buffer — use tpudp_ring_shift (the grouped
+// sendrecv, send and recv overlapped on a sender thread) for that pattern,
+// exactly as NCCL requires ncclGroupStart/End around symmetric p2p.
+// Arbitrary-pair routing is the device path's job (lax.ppermute), not this
+// host fallback's.
+int tpudp_ring_send_next(void* vctx, const char* data, int64_t nbytes) {
+  RingCtx* ctx = (RingCtx*)vctx;
+  if (!ctx || nbytes < 0) return -1;
+  if (nbytes == 0) return 0;
+  if (ctx->world == 1) {  // self-loop: queue for our own recv_prev
+    ctx->self_queue.emplace_back(data, data + nbytes);
+    return 0;
+  }
+  return write_full(ctx->next_fd, data, (size_t)nbytes);
+}
+
+int tpudp_ring_recv_prev(void* vctx, char* data, int64_t nbytes) {
+  RingCtx* ctx = (RingCtx*)vctx;
+  if (!ctx || nbytes < 0) return -1;
+  if (nbytes == 0) return 0;
+  if (ctx->world == 1) {
+    if (ctx->self_queue.empty() ||
+        (int64_t)ctx->self_queue.front().size() != nbytes)
+      return -1;  // no matching send_next queued: refuse, don't fabricate
+    memcpy(data, ctx->self_queue.front().data(), (size_t)nbytes);
+    ctx->self_queue.pop_front();
+    return 0;
+  }
+  return read_full(ctx->prev_fd, data, (size_t)nbytes);
+}
+
+// Collective shift-by-k (the host analogue of lax.ppermute with a shift
+// permutation): every rank's buffer ends holding the data that started on
+// rank (r - k) mod world. k hops of overlapped neighbor exchange.
+int tpudp_ring_shift(void* vctx, char* data, int64_t nbytes, int k) {
+  RingCtx* ctx = (RingCtx*)vctx;
+  if (!ctx || nbytes < 0) return -1;
+  int world = ctx->world;
+  k = ((k % world) + world) % world;
+  if (world == 1 || nbytes == 0 || k == 0) return 0;
+  std::vector<char> tmp((size_t)nbytes);
+  for (int hop = 0; hop < k; ++hop) {
+    int send_rc = 0;
+    std::thread sender(
+        [&]() { send_rc = write_full(ctx->next_fd, data, (size_t)nbytes); });
+    int recv_rc = read_full(ctx->prev_fd, tmp.data(), (size_t)nbytes);
+    sender.join();
+    if (send_rc != 0 || recv_rc != 0) return -1;
+    memcpy(data, tmp.data(), (size_t)nbytes);
   }
   return 0;
 }

@@ -261,8 +261,19 @@ def make_train_step_shard_map(
 
     def local_step(state: TrainState, batch):
         images, labels = _maybe_normalize(batch["image"]), batch["label"]
+        # Mark the replicated params as device-varying before differentiating.
+        # Under shard_map's replication typing, grads of a *varying* loss wrt
+        # *invariant* params would get an implicit cross-shard psum inserted
+        # by AD (the cotangent of the invariant→varying broadcast) — i.e.
+        # globally-summed grads before our explicit collective, which would
+        # overscale the update by the world size. `pvary` keeps AD local:
+        # per-shard grads out, exactly what DDP's reducer sees pre-allreduce.
+        local_params = jax.tree_util.tree_map(
+            lambda p: jax.lax.pvary(p, DATA_AXIS), state.params
+        )
         loss, grads, new_batch_stats, correct = _forward_backward(
-            model, cross_entropy_loss, state, images, labels
+            model, cross_entropy_loss, state.replace(params=local_params),
+            images, labels
         )
 
         # The explicit DDP all-reduce: grad mean over the data axis.
@@ -287,12 +298,14 @@ def make_train_step_shard_map(
         }
         return new_state, metrics
 
+    # Replication checking stays ON: an output that is rank-varying (a
+    # forgotten pmean/psum on a new metric) is a trace-time error instead of
+    # a silent wrong answer from device 0's shard.
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
         in_specs=(repl_spec, batch_spec),
         out_specs=(repl_spec, repl_spec),
-        check_vma=False,
     )
     return jax.jit(
         sharded,
