@@ -9,6 +9,7 @@ identical to the XLA collective path: allreduce(sum/mean) + barrier
 
 import multiprocessing as mp
 import pickle
+import traceback
 
 import numpy as np
 import pytest
@@ -36,8 +37,8 @@ def _ring_worker(rank, world, base_port, conn):
             meaned = ring.allreduce(data.copy(), op="mean")
             ring.barrier()
         conn.send(pickle.dumps((rank, data, summed, meaned)))
-    except BaseException as e:  # surface the failure to the parent
-        conn.send(pickle.dumps(e))
+    except BaseException:  # surface the failure to the parent
+        conn.send(pickle.dumps(("__error__", traceback.format_exc())))
     finally:
         conn.close()
 
@@ -59,8 +60,8 @@ def test_ring_allreduce_multiprocess(world):
     for parent, p in zip(pipes, procs):
         payload = pickle.loads(parent.recv())
         p.join(timeout=30)
-        if isinstance(payload, BaseException):
-            raise payload
+        if isinstance(payload, tuple) and payload[0] == "__error__":
+            pytest.fail(f"worker failed:\n{payload[1]}")
         results.append(payload)
 
     expected_sum = np.sum([r[1] for r in results], axis=0)
@@ -89,8 +90,8 @@ def _bcast_gather_worker(rank, world, base_port, conn):
             )
             ring.barrier()
         conn.send(pickle.dumps((rank, bcast, gathered)))
-    except BaseException as e:
-        conn.send(pickle.dumps(e))
+    except BaseException:
+        conn.send(pickle.dumps(("__error__", traceback.format_exc())))
     finally:
         conn.close()
 
@@ -115,8 +116,8 @@ def test_ring_broadcast_allgather_multiprocess(world):
     for parent, p in zip(pipes, procs):
         payload = pickle.loads(parent.recv())
         p.join(timeout=30)
-        if isinstance(payload, BaseException):
-            raise payload
+        if isinstance(payload, tuple) and payload[0] == "__error__":
+            pytest.fail(f"worker failed:\n{payload[1]}")
         _, bcast, gathered = payload
         np.testing.assert_array_equal(bcast, expected_bcast)
         np.testing.assert_array_equal(gathered, expected_gather)
@@ -151,8 +152,8 @@ def _primitive_worker(rank, world, base_port, conn):
             assert np.all(exchanged == float((rank - 1) % world))
             ring.barrier()
         conn.send(pickle.dumps((rank, contrib, reduced, seg, from_prev, shifted)))
-    except BaseException as e:
-        conn.send(pickle.dumps(e))
+    except BaseException:
+        conn.send(pickle.dumps(("__error__", traceback.format_exc())))
     finally:
         conn.close()
 
@@ -181,8 +182,8 @@ def test_ring_reduce_scatter_p2p_shift_multiprocess(world):
             pytest.fail("p2p worker deadlocked (no result within 120s)")
         payload = pickle.loads(parent.recv())
         p.join(timeout=30)
-        if isinstance(payload, BaseException):
-            raise payload
+        if isinstance(payload, tuple) and payload[0] == "__error__":
+            pytest.fail(f"worker failed:\n{payload[1]}")
         results.append(payload)
 
     total = np.sum([r[1] for r in results], axis=0)

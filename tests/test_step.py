@@ -22,8 +22,8 @@ from tpu_dp.train import (
 )
 
 
-def _make_batch(rng, n):
-    ds = make_synthetic(n, 10, seed=0, name="synthetic")
+def _make_batch(seed, n):
+    ds = make_synthetic(n, 10, seed=seed, name="synthetic")
     return {"image": normalize(ds.images), "label": ds.labels}
 
 
@@ -48,7 +48,7 @@ def setup():
 def test_dp_equivalence_8_vs_1(setup, mesh8, mesh1, rng):
     """Same global batch ⇒ same updated params on a 1-mesh and an 8-mesh."""
     model, opt, state = setup
-    batch = _make_batch(rng, 16)
+    batch = _make_batch(0, 16)
 
     step8 = make_train_step(model, opt, mesh8, constant_lr(0.01))
     step1 = make_train_step(model, opt, mesh1, constant_lr(0.01))
@@ -71,7 +71,7 @@ def test_multi_step_trajectory_equivalence(setup, mesh8, mesh1, rng):
     step1 = make_train_step(model, opt, mesh1, constant_lr(0.05))
     s8, s1 = _copy(state), _copy(state)
     for i in range(3):
-        batch = _make_batch(np.random.default_rng(i), 8)
+        batch = _make_batch(i, 8)
         s8, _ = step8(s8, batch)
         s1, _ = step1(s1, batch)
     for a, b in zip(
@@ -94,7 +94,7 @@ def test_shard_map_matches_gspmd(setup, mesh8, rng):
     step_s = make_train_step_shard_map(model, opt, mesh8, constant_lr(0.05))
     sg, ss = _copy(state), _copy(state)
     for i in range(3):
-        batch = _make_batch(np.random.default_rng(i), 16)
+        batch = _make_batch(i, 16)
         sg, mg = step_g(sg, batch)
         ss, ms = step_s(ss, batch)
         np.testing.assert_allclose(
@@ -123,7 +123,7 @@ def test_shard_map_sync_bn_resnet(mesh8, rng):
     step_g = make_train_step(model_g, opt, mesh8, constant_lr(0.05))
     step_s = make_train_step_shard_map(model_s, opt, mesh8, constant_lr(0.05))
     sg, ss = _copy(state), _copy(state)
-    batch = _make_batch(rng, 16)
+    batch = _make_batch(0, 16)
     sg, mg = step_g(sg, batch)
     ss, ms = step_s(ss, batch)
     np.testing.assert_allclose(float(mg["loss"]), float(ms["loss"]), rtol=1e-5)
@@ -161,7 +161,7 @@ def test_loss_decreases(setup, mesh8, rng):
 def test_step_counter_and_lr(setup, mesh8, rng):
     model, opt, state = setup
     step = make_train_step(model, opt, mesh8, constant_lr(0.01))
-    batch = _make_batch(rng, 8)
+    batch = _make_batch(0, 8)
     state = _copy(state)
     prev_step = int(state.step)
     s1, m = step(state, batch)
@@ -172,7 +172,93 @@ def test_step_counter_and_lr(setup, mesh8, rng):
 def test_eval_step_counts(setup, mesh8, rng):
     model, opt, state = setup
     ev = make_eval_step(model, mesh8)
-    batch = _make_batch(rng, 24)
+    batch = _make_batch(0, 24)
     m = ev(state, batch)
     assert int(m["count"]) == 24
     assert 0 <= int(m["correct"]) <= 24
+
+
+def test_scanned_multi_step_matches_host_loop(setup, mesh8):
+    """K scanned steps (one dispatch) ≡ K host-loop step calls, exactly.
+
+    `make_multi_step` is the device-side training loop (lax.scan over the
+    step body); its trajectory, per-step losses, and LR schedule positions
+    must be indistinguishable from driving `make_train_step` from the host.
+    """
+    import jax.numpy as jnp
+
+    from tpu_dp.train import cosine_lr, make_multi_step
+
+    model, opt, state = setup
+    K, n = 4, 16
+    sched = cosine_lr(0.05, 10, 2)
+    step = make_train_step(model, opt, mesh8, sched)
+    loop = make_multi_step(model, opt, mesh8, sched, num_steps=K)
+
+    batches = [_make_batch(100 + i, n) for i in range(K)]
+    pool = {
+        "image": np.stack([b["image"] for b in batches]),
+        "label": np.stack([b["label"] for b in batches]),
+    }
+
+    s_host = _copy(state)
+    host_metrics = []
+    for b in batches:
+        s_host, m = step(s_host, b)
+        host_metrics.append(m)
+
+    s_scan, stacked = loop(_copy(state), pool)
+
+    assert int(s_scan.step) == int(s_host.step)
+    for i, m in enumerate(host_metrics):
+        np.testing.assert_allclose(
+            float(stacked["loss"][i]), float(m["loss"]), rtol=1e-5
+        )
+        assert int(stacked["correct"][i]) == int(m["correct"])
+        np.testing.assert_allclose(
+            float(stacked["lr"][i]), float(m["lr"]), rtol=1e-6
+        )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_scan.params),
+        jax.tree_util.tree_leaves(s_host.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_scanned_loop_modular_pool_matches_host_loop(setup, mesh8):
+    """Pool-cycling branch (pool < num_steps) ≡ host loop cycling batches.
+
+    This is the exact path bench.py measures (4-slot pool, 30-step window):
+    the in-program modular gather must feed batch i % pool to step i.
+    """
+    from tpu_dp.train import cosine_lr, make_multi_step
+
+    model, opt, state = setup
+    K, pool_n, n = 6, 3, 16
+    sched = cosine_lr(0.05, 10, 2)
+    step = make_train_step(model, opt, mesh8, sched)
+    loop = make_multi_step(model, opt, mesh8, sched, num_steps=K)
+
+    batches = [_make_batch(200 + i, n) for i in range(pool_n)]
+    pool = {
+        "image": np.stack([b["image"] for b in batches]),
+        "label": np.stack([b["label"] for b in batches]),
+    }
+
+    s_host = _copy(state)
+    host_losses = []
+    for i in range(K):
+        s_host, m = step(s_host, batches[i % pool_n])
+        host_losses.append(float(m["loss"]))
+
+    s_scan, stacked = loop(_copy(state), pool)
+
+    assert int(s_scan.step) == K
+    np.testing.assert_allclose(
+        np.asarray(stacked["loss"]), np.asarray(host_losses), rtol=1e-5
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_scan.params),
+        jax.tree_util.tree_leaves(s_host.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)

@@ -24,12 +24,21 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def scan_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for batches with a leading scan axis (microbatches under
+    gradient accumulation, step windows under `make_multi_step`): scan dim
+    replicated, batch dim sharded over ``data``."""
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully-replicated sharding (parameters, opt state, scalars)."""
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch: Any, mesh: Mesh, spec: P | None = None) -> Any:
+def shard_batch(
+    batch: Any, mesh: Mesh, spec: P | NamedSharding | None = None
+) -> Any:
     """Place a host batch pytree onto the mesh, sharded on dim 0.
 
     The host→device copy boundary of the reference's hot loop
@@ -40,9 +49,12 @@ def shard_batch(batch: Any, mesh: Mesh, spec: P | None = None) -> Any:
     leading-dim partitioning (e.g. ``P(None, 'data')`` for
     gradient-accumulation batches with a scan axis in front).
     """
-    sharding = (
-        batch_sharding(mesh) if spec is None else NamedSharding(mesh, spec)
-    )
+    if spec is None:
+        sharding = batch_sharding(mesh)
+    elif isinstance(spec, NamedSharding):
+        sharding = spec
+    else:
+        sharding = NamedSharding(mesh, spec)
     if jax.process_count() > 1:
         return jax.tree_util.tree_map(
             lambda x: jax.make_array_from_process_local_data(sharding, x), batch

@@ -25,7 +25,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from tpu_dp.parallel.sharding import batch_sharding, replicated_sharding
+from tpu_dp.parallel.sharding import (
+    batch_sharding,
+    replicated_sharding,
+    scan_batch_sharding,
+)
 from tpu_dp.train.optim import Optimizer
 from tpu_dp.train.schedule import Schedule
 from tpu_dp.train.state import TrainState
@@ -120,6 +124,35 @@ def _apply_update(
     return new_state, lr
 
 
+def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn):
+    """The single-microbatch step body shared by `make_train_step`
+    (accum_steps=1) and `make_multi_step`'s scan — one source of truth for
+    normalize → augment → fwd/bwd → update → metrics, so the host-loop and
+    device-loop paths cannot drift apart."""
+
+    def body(state: TrainState, batch):
+        images, labels = _maybe_normalize(batch["image"]), batch["label"]
+        if augment_fn is not None:
+            # Keyed by the global step: compiled into the program,
+            # deterministic, identical on every replica.
+            images = augment_fn(state.step, images)
+        loss, grads, new_batch_stats, correct = _forward_backward(
+            model, loss_impl, state, images, labels
+        )
+        new_state, lr = _apply_update(
+            optimizer, schedule, state, grads, new_batch_stats
+        )
+        metrics = {
+            "loss": loss,
+            "correct": correct,
+            "count": jnp.asarray(labels.shape[0], jnp.int32),
+            "lr": lr,
+        }
+        return new_state, metrics
+
+    return body
+
+
 def make_train_step(
     model,
     optimizer: Optimizer,
@@ -145,56 +178,47 @@ def make_train_step(
     else:
         loss_impl = cross_entropy_loss
 
-    def step(state: TrainState, batch):
+    def step_accum(state: TrainState, batch):
         images, labels = _maybe_normalize(batch["image"]), batch["label"]
         if augment_fn is not None:
-            # On-device augmentation keyed by the global step (and the
-            # microbatch index under accumulation): compiled into the step,
-            # deterministic, identical on every replica.
-            if accum_steps == 1:
-                images = augment_fn(state.step, images)
-            else:
-                images = jax.vmap(
-                    lambda i, im: augment_fn(state.step * accum_steps + i, im)
-                )(jnp.arange(accum_steps), images)
-        if accum_steps == 1:
-            loss, grads, new_batch_stats, correct = _forward_backward(
-                model, loss_impl, state, images, labels
+            # On-device augmentation keyed by the global step and the
+            # microbatch index: compiled into the step, deterministic,
+            # identical on every replica.
+            images = jax.vmap(
+                lambda i, im: augment_fn(state.step * accum_steps + i, im)
+            )(jnp.arange(accum_steps), images)
+        # Gradient accumulation: batch leaves carry a leading
+        # (accum_steps,) axis (replicated; the microbatch dim is the
+        # sharded one). lax.scan runs the microbatches sequentially,
+        # accumulating grads on-device; one optimizer update per step.
+        # This is how a logical global batch larger than HBM (e.g.
+        # BASELINE config 5's 4096) runs on few chips.
+        def micro(carry, mb):
+            grads_acc, batch_stats, loss_acc, correct_acc = carry
+            mstate = state.replace(batch_stats=batch_stats)
+            loss, grads, new_bs, correct = _forward_backward(
+                model, loss_impl, mstate, mb["image"], mb["label"]
             )
-            count = labels.shape[0]
-        else:
-            # Gradient accumulation: batch leaves carry a leading
-            # (accum_steps,) axis (replicated; the microbatch dim is the
-            # sharded one). lax.scan runs the microbatches sequentially,
-            # accumulating grads on-device; one optimizer update per step.
-            # This is how a logical global batch larger than HBM (e.g.
-            # BASELINE config 5's 4096) runs on few chips.
-            def micro(carry, mb):
-                grads_acc, batch_stats, loss_acc, correct_acc = carry
-                mstate = state.replace(batch_stats=batch_stats)
-                loss, grads, new_bs, correct = _forward_backward(
-                    model, loss_impl, mstate, mb["image"], mb["label"]
-                )
-                grads_acc = jax.tree_util.tree_map(
-                    jnp.add, grads_acc, grads
-                )
-                return (grads_acc, new_bs, loss_acc + loss,
-                        correct_acc + correct), None
+            grads_acc = jax.tree_util.tree_map(
+                jnp.add, grads_acc, grads
+            )
+            return (grads_acc, new_bs, loss_acc + loss,
+                    correct_acc + correct), None
 
-            init = (
-                jax.tree_util.tree_map(jnp.zeros_like, state.params),
-                state.batch_stats,
-                jnp.zeros((), jnp.float32),
-                jnp.zeros((), jnp.int32),
-            )
-            (grads, new_batch_stats, loss_sum, correct), _ = jax.lax.scan(
-                micro, init, {"image": images, "label": labels}
-            )
-            grads = jax.tree_util.tree_map(
-                lambda g: g / accum_steps, grads
-            )
-            loss = loss_sum / accum_steps
-            count = labels.shape[0] * labels.shape[1]
+        init = (
+            jax.tree_util.tree_map(jnp.zeros_like, state.params),
+            state.batch_stats,
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.int32),
+        )
+        (grads, new_batch_stats, loss_sum, correct), _ = jax.lax.scan(
+            micro, init, {"image": images, "label": labels}
+        )
+        grads = jax.tree_util.tree_map(
+            lambda g: g / accum_steps, grads
+        )
+        loss = loss_sum / accum_steps
+        count = labels.shape[0] * labels.shape[1]
 
         new_state, lr = _apply_update(
             optimizer, schedule, state, grads, new_batch_stats
@@ -211,15 +235,76 @@ def make_train_step(
     # the optional weight mask) shards on its leading dim — or, with
     # accumulation, on the microbatch dim after the scan axis.
     if accum_steps == 1:
+        step = _make_step_body(model, optimizer, schedule, loss_impl, augment_fn)
         in_batch_sh = batch_sh
     else:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from tpu_dp.parallel.dist import DATA_AXIS
-
-        in_batch_sh = NamedSharding(mesh, P(None, DATA_AXIS))
+        step = step_accum
+        in_batch_sh = scan_batch_sharding(mesh)
     return jax.jit(
         step,
+        in_shardings=(repl, in_batch_sh),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+
+
+def make_multi_step(
+    model,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    schedule: Schedule,
+    num_steps: int,
+    use_pallas_xent: bool = False,
+    augment_fn: Callable | None = None,
+) -> Callable:
+    """Device-side training loop: ``num_steps`` train steps in ONE program.
+
+    ``lax.scan`` over the same step body `make_train_step` compiles, fed by a
+    device-resident pool of batches with a leading (num_steps,) axis. One
+    dispatch executes the whole window, so host→device round-trips (launch
+    latency, relay RTT in tunneled setups) amortize across the window — the
+    reference's eager loop pays them every step
+    (`/root/reference/cifar_example_ddp.py:94-107`). Semantically identical
+    to calling the single step ``num_steps`` times (equivalence-tested);
+    metrics come back stacked per step.
+
+    Returns ``loop(state, batches) -> (new_state, stacked_metrics)`` where
+    every ``batches`` leaf has shape (pool, global_batch, ...). When
+    ``pool == num_steps`` the scan consumes the pool directly; a smaller
+    pool is cycled modularly *inside* the program (device-side gather per
+    step), so HBM cost stays constant in ``num_steps`` — e.g. a benchmark
+    can run a 30-step window over 4 staged batches without 30 copies.
+    """
+    repl = replicated_sharding(mesh)
+    if use_pallas_xent:
+        from tpu_dp.ops.xent import mean_softmax_xent as loss_impl
+    else:
+        loss_impl = cross_entropy_loss
+
+    body = _make_step_body(model, optimizer, schedule, loss_impl, augment_fn)
+
+    def loop(state: TrainState, batches):
+        pool = jax.tree_util.tree_leaves(batches)[0].shape[0]
+        if pool == num_steps:
+            return jax.lax.scan(body, state, batches, length=num_steps)
+
+        def indexed_body(st, i):
+            mb = jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_index_in_dim(
+                    x, i % pool, keepdims=False
+                ),
+                batches,
+            )
+            return body(st, mb)
+
+        return jax.lax.scan(
+            indexed_body, state, jnp.arange(num_steps, dtype=jnp.int32)
+        )
+
+    # Scan axis in front, batch dim sharded over data.
+    in_batch_sh = scan_batch_sharding(mesh)
+    return jax.jit(
+        loop,
         in_shardings=(repl, in_batch_sh),
         out_shardings=(repl, repl),
         donate_argnums=(0,),
