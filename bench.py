@@ -44,14 +44,17 @@ def main() -> None:
     from tpu_dp.data.cifar import make_synthetic
     from tpu_dp.models import ResNet18
     from tpu_dp.parallel import dist
-    from tpu_dp.parallel.sharding import shard_batch
-    from tpu_dp.train import SGD, cosine_lr, create_train_state
+    from tpu_dp.parallel.sharding import scan_batch_sharding, shard_batch
+    from tpu_dp.train import (
+        SGD,
+        cosine_lr,
+        create_train_state,
+        make_multi_step,
+    )
 
     mesh = dist.data_mesh()
     n_chips = int(mesh.devices.size)
     global_batch = PER_CHIP_BATCH * n_chips
-
-    from tpu_dp.train import make_multi_step
 
     model = ResNet18(num_classes=10, dtype=jnp.bfloat16)
     opt = SGD(momentum=0.9, weight_decay=5e-4)
@@ -74,8 +77,6 @@ def main() -> None:
     # it modularly inside the program, so HBM cost is 4 batches regardless
     # of window length. uint8 batches: the compiled step fuses the normalize
     # on device, matching the production pipeline's host->HBM format.
-    from tpu_dp.parallel.sharding import scan_batch_sharding
-
     host_pool = [make_synthetic(global_batch, 10, seed=i, name="bench")
                  for i in range(4)]
     stacked = {

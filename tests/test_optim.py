@@ -62,3 +62,30 @@ def test_cross_entropy_matches_torch():
         torch.nn.CrossEntropyLoss()(torch.tensor(logits), torch.tensor(labels))
     )
     assert ours == pytest.approx(ref, rel=1e-5)
+
+
+def test_weight_decay_exclusion_mask():
+    """decay_exclude_bias_and_norm: bias/scale leaves get no L2 pull."""
+    import jax
+
+    params = {
+        "conv": {"kernel": jnp.ones((2, 2))},
+        "norm": {"scale": jnp.ones((2,)), "bias": jnp.ones((2,))},
+        "dense": {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))},
+    }
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    opt = SGD(momentum=0.0, weight_decay=0.1, decay_exclude_bias_and_norm=True)
+    new_params, _ = opt.update(grads, opt.init(params), params, lr=1.0)
+
+    # Zero grads: kernels shrink by lr*wd*p = 0.1, excluded leaves unchanged.
+    np.testing.assert_allclose(np.asarray(new_params["conv"]["kernel"]), 0.9)
+    np.testing.assert_allclose(np.asarray(new_params["dense"]["kernel"]), 0.9)
+    np.testing.assert_allclose(np.asarray(new_params["norm"]["scale"]), 1.0)
+    np.testing.assert_allclose(np.asarray(new_params["norm"]["bias"]), 1.0)
+    np.testing.assert_allclose(np.asarray(new_params["dense"]["bias"]), 1.0)
+
+    # Default (torch parity): everything decays.
+    opt_all = SGD(momentum=0.0, weight_decay=0.1)
+    all_params, _ = opt_all.update(grads, opt_all.init(params), params, lr=1.0)
+    np.testing.assert_allclose(np.asarray(all_params["norm"]["scale"]), 0.9)

@@ -45,7 +45,7 @@ def setup():
     return model, opt, state
 
 
-def test_dp_equivalence_8_vs_1(setup, mesh8, mesh1, rng):
+def test_dp_equivalence_8_vs_1(setup, mesh8, mesh1):
     """Same global batch ⇒ same updated params on a 1-mesh and an 8-mesh."""
     model, opt, state = setup
     batch = _make_batch(0, 16)
@@ -64,7 +64,7 @@ def test_dp_equivalence_8_vs_1(setup, mesh8, mesh1, rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
-def test_multi_step_trajectory_equivalence(setup, mesh8, mesh1, rng):
+def test_multi_step_trajectory_equivalence(setup, mesh8, mesh1):
     """Replicas stay in lockstep over several steps (momentum included)."""
     model, opt, state = setup
     step8 = make_train_step(model, opt, mesh8, constant_lr(0.05))
@@ -80,7 +80,7 @@ def test_multi_step_trajectory_equivalence(setup, mesh8, mesh1, rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
-def test_shard_map_matches_gspmd(setup, mesh8, rng):
+def test_shard_map_matches_gspmd(setup, mesh8):
     """Explicit-collectives path ≡ GSPMD-inferred path, step for step.
 
     Two statements of the same distributed program — per-shard grads +
@@ -108,7 +108,7 @@ def test_shard_map_matches_gspmd(setup, mesh8, rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
-def test_shard_map_sync_bn_resnet(mesh8, rng):
+def test_shard_map_sync_bn_resnet(mesh8):
     """shard_map path with a BatchNorm model (axis_name-synced stats)."""
     from tpu_dp.models import ResNet18
     from tpu_dp.parallel.dist import DATA_AXIS
@@ -140,7 +140,7 @@ def test_shard_map_sync_bn_resnet(mesh8, rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
-def test_loss_decreases(setup, mesh8, rng):
+def test_loss_decreases(setup, mesh8):
     """The reference's in-band signal: running loss goes down."""
     model, opt, state = setup
     step = make_train_step(model, opt, mesh8, constant_lr(0.05))
@@ -158,7 +158,7 @@ def test_loss_decreases(setup, mesh8, rng):
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.05
 
 
-def test_step_counter_and_lr(setup, mesh8, rng):
+def test_step_counter_and_lr(setup, mesh8):
     model, opt, state = setup
     step = make_train_step(model, opt, mesh8, constant_lr(0.01))
     batch = _make_batch(0, 8)
@@ -169,7 +169,7 @@ def test_step_counter_and_lr(setup, mesh8, rng):
     assert float(m["lr"]) == pytest.approx(0.01)
 
 
-def test_eval_step_counts(setup, mesh8, rng):
+def test_eval_step_counts(setup, mesh8):
     model, opt, state = setup
     ev = make_eval_step(model, mesh8)
     batch = _make_batch(0, 24)

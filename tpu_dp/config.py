@@ -42,6 +42,9 @@ class OptimConfig:
     lr: float = 0.001  # `cifar_example.py:64`
     momentum: float = 0.9  # `cifar_example.py:64`
     weight_decay: float = 0.0
+    # Exclude biases + norm scale/bias from decay (common high-accuracy
+    # recipe); off by default for torch SGD parity (decays everything).
+    decay_exclude_bias_and_norm: bool = False
     schedule: str = "constant"  # constant | cosine
     warmup_epochs: float = 0.0
     final_lr: float = 0.0

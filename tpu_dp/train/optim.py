@@ -30,12 +30,28 @@ class Optimizer(Protocol):
     def update(self, grads, opt_state, params, lr) -> tuple[Any, Any]: ...
 
 
+def _is_no_decay_leaf(path) -> bool:
+    """True for leaves conventionally excluded from weight decay: biases and
+    normalization scales (BatchNorm parameters are named scale/bias in Flax;
+    Dense/Conv biases are named bias). Matches the common high-accuracy
+    ResNet recipe; torch's SGD decays everything, which stays the default."""
+    last = path[-1]
+    name = getattr(last, "key", getattr(last, "name", str(last)))
+    return name in ("bias", "scale")
+
+
 class SGD:
     """Torch-semantics SGD(momentum) as a stateless pytree transform."""
 
-    def __init__(self, momentum: float = 0.9, weight_decay: float = 0.0):
+    def __init__(
+        self,
+        momentum: float = 0.9,
+        weight_decay: float = 0.0,
+        decay_exclude_bias_and_norm: bool = False,
+    ):
         self.momentum = momentum
         self.weight_decay = weight_decay
+        self.decay_exclude_bias_and_norm = decay_exclude_bias_and_norm
 
     def init(self, params):
         if self.momentum == 0.0:
@@ -45,9 +61,18 @@ class SGD:
     def update(self, grads, opt_state, params, lr):
         """Returns (new_params, new_opt_state)."""
         if self.weight_decay:
-            grads = jax.tree_util.tree_map(
-                lambda g, p: g + self.weight_decay * p, grads, params
-            )
+            if self.decay_exclude_bias_and_norm:
+                grads = jax.tree_util.tree_map_with_path(
+                    lambda path, g, p: g
+                    if _is_no_decay_leaf(path)
+                    else g + self.weight_decay * p,
+                    grads,
+                    params,
+                )
+            else:
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: g + self.weight_decay * p, grads, params
+                )
         if self.momentum == 0.0:
             new_params = jax.tree_util.tree_map(
                 lambda p, g: p - lr * g, params, grads

@@ -124,6 +124,15 @@ def _apply_update(
     return new_state, lr
 
 
+def _select_loss_impl(use_pallas_xent: bool):
+    """One source of truth for the loss implementation switch."""
+    if use_pallas_xent:
+        from tpu_dp.ops.xent import mean_softmax_xent
+
+        return mean_softmax_xent
+    return cross_entropy_loss
+
+
 def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn):
     """The single-microbatch step body shared by `make_train_step`
     (accum_steps=1) and `make_multi_step`'s scan — one source of truth for
@@ -173,10 +182,7 @@ def make_train_step(
     """
     repl = replicated_sharding(mesh)
     batch_sh = batch_sharding(mesh)
-    if use_pallas_xent:
-        from tpu_dp.ops.xent import mean_softmax_xent as loss_impl
-    else:
-        loss_impl = cross_entropy_loss
+    loss_impl = _select_loss_impl(use_pallas_xent)
 
     def step_accum(state: TrainState, batch):
         images, labels = _maybe_normalize(batch["image"]), batch["label"]
@@ -276,10 +282,7 @@ def make_multi_step(
     can run a 30-step window over 4 staged batches without 30 copies.
     """
     repl = replicated_sharding(mesh)
-    if use_pallas_xent:
-        from tpu_dp.ops.xent import mean_softmax_xent as loss_impl
-    else:
-        loss_impl = cross_entropy_loss
+    loss_impl = _select_loss_impl(use_pallas_xent)
 
     body = _make_step_body(model, optimizer, schedule, loss_impl, augment_fn)
 

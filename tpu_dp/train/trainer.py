@@ -89,7 +89,11 @@ class Trainer:
 
         steps_per_epoch = len(self.train_pipe)
         total_steps = steps_per_epoch * cfg.train.epochs
-        self.optimizer = SGD(cfg.optim.momentum, cfg.optim.weight_decay)
+        self.optimizer = SGD(
+            cfg.optim.momentum,
+            cfg.optim.weight_decay,
+            decay_exclude_bias_and_norm=cfg.optim.decay_exclude_bias_and_norm,
+        )
         self.schedule = make_schedule(
             cfg.optim.schedule, cfg.optim.lr, total_steps,
             int(cfg.optim.warmup_epochs * steps_per_epoch), cfg.optim.final_lr,
