@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""The reference's tutorial, re-told TPU-native — in one page.
+
+This script mirrors the *shape* of `/root/reference/cifar_example_ddp.py`
+(init → data → model → train loop → save → synced eval) so a reader of the
+reference can see each piece's equivalent, but drives the tpu_dp library
+directly instead of `train.py`'s Trainer. The differences ARE the tutorial:
+
+- no launcher fork: the same script is single-chip or a full slice — the
+  mesh is however many devices are visible (reference needs `torchrun` and
+  a separate non-DDP script);
+- no DDP wrapper, no gradient hooks: the whole hot loop
+  (`cifar_example_ddp.py:94-107`) is ONE compiled XLA program whose
+  cross-chip gradient all-reduce GSPMD inserts from shardings;
+- no DistributedSampler object: the pipeline shards per-process and
+  reshuffles per epoch (`set_epoch` semantics) internally;
+- eval counts are exact global values out of the compiled step — what
+  `torchmetrics.Accuracy(dist_sync_on_step=True)` approximates with a
+  per-update allreduce (`cifar_example_ddp.py:124-136`).
+
+Run: `python examples/cifar_minimal.py` (synthetic data if no CIFAR on disk;
+CPU works — on a TPU host the same command uses every chip).
+"""
+
+import jax
+import numpy as np
+
+from tpu_dp.checkpoint import save_params
+from tpu_dp.data.cifar import load_dataset
+from tpu_dp.data.pipeline import DataPipeline
+from tpu_dp.models import Net
+from tpu_dp.parallel import dist
+from tpu_dp.train import SGD, constant_lr, create_train_state, make_eval_step, make_train_step
+from tpu_dp.utils import print0
+
+EPOCHS = 2          # cifar_example.py:66
+BATCH = 4           # per-process, cifar_example.py:46
+LR, MOMENTUM = 0.001, 0.9  # cifar_example.py:64
+LOG_EVERY = 2000    # cifar_example.py:84
+
+
+def main():
+    dist.initialize()                      # ≙ init_distributed (ddp.py:42-58)
+    mesh = dist.data_mesh()                # the world; 1 chip or 8, same code
+
+    train_ds = load_dataset("cifar10", "./data", train=True)
+    test_ds = load_dataset("cifar10", "./data", train=False)
+    train_pipe = DataPipeline(train_ds, BATCH, mesh, shuffle=True)
+    test_pipe = DataPipeline(test_ds, BATCH, mesh, shuffle=False,
+                             drop_remainder=False)
+
+    model = Net()                          # exact reference topology
+    state = create_train_state(
+        model, jax.random.PRNGKey(0),
+        np.zeros((1, 32, 32, 3), np.float32), SGD(MOMENTUM),
+    )
+    step = make_train_step(model, SGD(MOMENTUM), mesh, constant_lr(LR))
+    eval_step = make_eval_step(model, mesh)
+
+    for epoch in range(EPOCHS):            # ddp.py:90
+        train_pipe.set_epoch(epoch)        # ddp.py:92
+        running, seen = 0.0, 0
+        for i, batch in enumerate(train_pipe):
+            state, metrics = step(state, batch)   # fwd+bwd+allreduce+sgd
+            running += float(metrics["loss"])
+            seen += 1
+            if (i + 1) % LOG_EVERY == 0:   # reference print format
+                print0(f"[{epoch + 1}, {i + 1:5d}] loss: {running / seen:.3f}")
+                running, seen = 0.0, 0
+
+    print0("Finished Training")
+    save_params("./cifar_net.msgpack", state.params)   # ≙ torch.save (:118)
+
+    correct = total = 0
+    for batch in test_pipe:
+        m = eval_step(state, batch)        # global counts, reduction in-step
+        correct += int(m["correct"])
+        total += int(m["count"])
+    # Reference prints a hardcoded "10000 test images" (cifar_example.py:111);
+    # real CIFAR gives exactly that, synthetic fallbacks report their size.
+    print0(
+        f"Accuracy of the network on the {total} test images: "
+        f"{100 * correct // max(total, 1)} %"
+    )
+
+
+if __name__ == "__main__":
+    main()

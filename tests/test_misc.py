@@ -102,3 +102,28 @@ def test_schedule_shapes():
     assert float(s(55)) == pytest.approx(0.5, abs=0.01)
     with pytest.raises(ValueError):
         make_schedule("nope", 0.1)
+
+
+def test_examples_cifar_minimal_smoke(tmp_path, monkeypatch, capsys):
+    """The migration example runs end-to-end (tiny synthetic data)."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).resolve().parent.parent))
+    import examples.cifar_minimal as ex
+    from tpu_dp.data.cifar import make_synthetic
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(ex, "EPOCHS", 1)
+    monkeypatch.setattr(ex, "BATCH", 16)
+    monkeypatch.setattr(ex, "LOG_EVERY", 4)
+    monkeypatch.setattr(
+        ex, "load_dataset",
+        lambda name, root, train=True, **kw: make_synthetic(
+            128 if train else 64, 10, seed=0, name="synthetic"
+        ),
+    )
+    ex.main()
+    out = capsys.readouterr().out
+    assert "Finished Training" in out
+    assert "Accuracy of the network on the 64 test images" in out
+    assert (tmp_path / "cifar_net.msgpack").exists()

@@ -223,3 +223,67 @@ def test_ring_world_one_is_identity():
     np.testing.assert_array_equal(reduced, data)
     np.testing.assert_array_equal(shifted, data)
     np.testing.assert_array_equal(echoed, data)
+
+
+def _dying_peer_worker(rank, world, base_port, conn):
+    try:
+        import os
+
+        from tpu_dp.ops.native.hostlib import Ring
+
+        ring = Ring("127.0.0.1", base_port, rank, world, timeout_ms=20_000)
+        if rank == 1:
+            # Die mid-collective without closing cleanly: peers must see a
+            # socket error from read/write, not hang.
+            conn.send(pickle.dumps((rank, "dying")))
+            conn.close()
+            os._exit(1)
+        try:
+            ring.allreduce(np.ones(300_000, np.float32))
+            outcome = "no-error"
+        except RuntimeError:
+            outcome = "raised"
+        conn.send(pickle.dumps((rank, outcome)))
+    except BaseException:
+        conn.send(pickle.dumps(("__error__", traceback.format_exc())))
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+def test_ring_peer_death_raises_not_hangs():
+    """Failure detection: a dead rank fails surviving ranks' collectives fast.
+
+    The reference has no failure handling at all (SURVEY.md §5); here a
+    peer's death mid-allreduce must surface as RuntimeError on the
+    survivors within the test timeout — never a silent hang (NCCL's analogue
+    is the watchdog abort).
+    """
+    world = 3
+    ctx = mp.get_context("spawn")
+    base_port = 24400
+    pipes, procs = [], []
+    for rank in range(world):
+        parent, child = ctx.Pipe()
+        p = ctx.Process(
+            target=_dying_peer_worker, args=(rank, world, base_port, child)
+        )
+        p.start()
+        pipes.append(parent)
+        procs.append(p)
+    outcomes = {}
+    for rank, (parent, p) in enumerate(zip(pipes, procs)):
+        if not parent.poll(60):
+            for q in procs:
+                q.terminate()
+            pytest.fail(f"rank {rank} hung after peer death (no failure detection)")
+        payload = pickle.loads(parent.recv())
+        p.join(timeout=30)
+        if isinstance(payload, tuple) and payload[0] == "__error__":
+            pytest.fail(f"worker failed:\n{payload[1]}")
+        outcomes[payload[0]] = payload[1]
+    assert outcomes[1] == "dying"
+    assert outcomes[0] == "raised"
+    assert outcomes[2] == "raised"
