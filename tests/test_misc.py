@@ -127,3 +127,59 @@ def test_examples_cifar_minimal_smoke(tmp_path, monkeypatch, capsys):
     assert "Finished Training" in out
     assert "Accuracy of the network on the 64 test images" in out
     assert (tmp_path / "cifar_net.msgpack").exists()
+
+
+def test_checkpoint_manager_retention_async_and_restore(tmp_path):
+    """CheckpointManager: async writes, keep-N pruning, latest-pointer restore."""
+    import jax.numpy as jnp
+
+    from tpu_dp.checkpoint import CheckpointManager
+    from tpu_dp.models import Net
+    from tpu_dp.train import SGD, create_train_state
+
+    model = Net()
+    opt = SGD(momentum=0.9)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32), opt
+    )
+
+    with CheckpointManager(tmp_path / "ck", keep=2, async_save=True) as mgr:
+        for n in (1, 2, 3, 4):
+            s = state.replace(step=jnp.asarray(n, jnp.int32))
+            mgr.save(s, meta={"epoch": n}, step=n)
+        mgr.wait()
+        kept = sorted(p.name for p in (tmp_path / "ck").iterdir()
+                      if p.name.startswith("step_"))
+        assert kept == ["step_0000000003", "step_0000000004"]
+
+        restored, meta = mgr.restore(state)
+        assert int(restored.step) == 4
+        assert meta["epoch"] == 4
+        for a, b in zip(
+            jax.tree_util.tree_leaves(restored.params),
+            jax.tree_util.tree_leaves(state.params),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Stale/corrupt latest pointer falls back to newest complete step dir.
+    (tmp_path / "ck" / "latest").write_text("step_9999999999")
+    mgr2 = CheckpointManager(tmp_path / "ck", keep=2)
+    assert mgr2.latest_dir().name == "step_0000000004"
+
+
+def test_checkpoint_manager_async_failure_surfaces(tmp_path):
+    """A failed async write raises on the next wait/save, never silently."""
+    from tpu_dp.checkpoint import CheckpointManager
+    from tpu_dp.models import Net
+    from tpu_dp.train import SGD, create_train_state
+
+    state = create_train_state(
+        Net(), jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
+        SGD(0.9),
+    )
+    target = tmp_path / "notadir"
+    target.write_text("file where the ckpt dir must go")  # mkdir will fail
+    mgr = CheckpointManager(target, async_save=True)
+    mgr.save(state, step=1)
+    with pytest.raises(RuntimeError, match="async checkpoint write"):
+        mgr.wait()

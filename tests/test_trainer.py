@@ -41,8 +41,10 @@ def test_fit_trains_and_evaluates(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "Finished Training" in out  # reference print parity
     assert "loss:" in out
-    # Checkpoint + final weights were written.
-    assert (tmp_path / "ck" / "state.msgpack").exists()
+    # Checkpoints (manager layout: step dirs + latest pointer) + final weights.
+    step_dirs = sorted((tmp_path / "ck").glob("step_*/state.msgpack"))
+    assert step_dirs, "no step checkpoints written"
+    assert (tmp_path / "ck" / "latest").exists()
     assert (tmp_path / "ck" / "final_params.msgpack").exists()
 
 

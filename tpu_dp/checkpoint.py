@@ -38,17 +38,12 @@ def _to_host(tree):
     return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
 
 
-def save_checkpoint(
-    ckpt_dir: str | os.PathLike,
-    state: TrainState,
-    meta: dict[str, Any] | None = None,
-) -> Path | None:
-    """Write state + metadata; process 0 only. Returns the path (rank 0)."""
-    ckpt_dir = Path(ckpt_dir)
-    if jax.process_index() != 0:
-        return None
+def _atomic_write_state(
+    ckpt_dir: Path, host_state, meta: dict[str, Any] | None
+) -> Path:
+    """The one atomic-write protocol (tmp file + rename) for state + meta."""
     ckpt_dir.mkdir(parents=True, exist_ok=True)
-    payload = serialization.to_bytes(_to_host(state))
+    payload = serialization.to_bytes(host_state)
     tmp = ckpt_dir / (_CKPT_NAME + ".tmp")
     tmp.write_bytes(payload)
     os.replace(tmp, ckpt_dir / _CKPT_NAME)
@@ -56,6 +51,17 @@ def save_checkpoint(
     meta_tmp.write_text(json.dumps(meta or {}, indent=2, default=str))
     os.replace(meta_tmp, ckpt_dir / _META_NAME)
     return ckpt_dir / _CKPT_NAME
+
+
+def save_checkpoint(
+    ckpt_dir: str | os.PathLike,
+    state: TrainState,
+    meta: dict[str, Any] | None = None,
+) -> Path | None:
+    """Write state + metadata; process 0 only. Returns the path (rank 0)."""
+    if jax.process_index() != 0:
+        return None
+    return _atomic_write_state(Path(ckpt_dir), _to_host(state), meta)
 
 
 def load_checkpoint(
@@ -72,6 +78,125 @@ def load_checkpoint(
 
 def checkpoint_exists(ckpt_dir: str | os.PathLike) -> bool:
     return (Path(ckpt_dir) / _CKPT_NAME).exists()
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention and async saves.
+
+    The manager features the reference entirely lacks (its one `torch.save`
+    is end-of-training, every-rank, same-path — `cifar_example.py:92-93`):
+
+    - each save lands in ``<dir>/step_<n>``, with an atomically-updated
+      ``latest`` pointer file, so a partially-written checkpoint is never
+      the one a resume sees;
+    - ``keep`` bounds disk: oldest step dirs are pruned after each save;
+    - ``async_save=True`` snapshots the state to host arrays synchronously
+      (cheap: device→host copy) and does serialization + IO on a worker
+      thread, so training never stalls on disk. ``wait()`` joins the
+      in-flight write (called automatically before the next save and by
+      ``close()``).
+
+    Process-0-only like the base functions; other processes no-op.
+    """
+
+    def __init__(self, ckpt_dir: str | os.PathLike, keep: int = 3,
+                 async_save: bool = True):
+        self.ckpt_dir = Path(ckpt_dir)
+        self.keep = int(keep)
+        self.async_save = bool(async_save)
+        self._thread = None
+        self._error: BaseException | None = None
+
+    def _step_dirs(self) -> list[Path]:
+        if not self.ckpt_dir.exists():
+            return []
+        dirs = [
+            p for p in self.ckpt_dir.iterdir()
+            if p.is_dir() and p.name.startswith("step_")
+        ]
+        return sorted(dirs, key=lambda p: int(p.name.split("_")[1]))
+
+    def wait(self) -> None:
+        """Join the in-flight async write; re-raise its failure, if any.
+
+        A checkpoint that silently failed to write is worse than a crash —
+        the run would keep training with nothing to resume from — so worker
+        exceptions surface here (and therefore on the next ``save``/
+        ``restore``/``close``), wrapped with the checkpoint context."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                f"async checkpoint write to {self.ckpt_dir} failed"
+            ) from err
+
+    def save(self, state: TrainState, meta: dict[str, Any] | None = None,
+             step: int | None = None) -> Path | None:
+        """Checkpoint ``state`` under ``step_<n>`` (n defaults to state.step)."""
+        if jax.process_index() != 0:
+            return None
+        self.wait()
+        n = int(state.step) if step is None else int(step)
+        step_dir = self.ckpt_dir / f"step_{n:010d}"
+        host_state = _to_host(state)  # snapshot NOW: donation-safe, consistent
+
+        def _write():
+            _atomic_write_state(step_dir, host_state, meta)
+            # Publish: latest points at a fully-written checkpoint only.
+            ptr_tmp = self.ckpt_dir / "latest.tmp"
+            ptr_tmp.write_text(step_dir.name)
+            os.replace(ptr_tmp, self.ckpt_dir / "latest")
+            # Retention: prune oldest beyond keep (never the one just written).
+            if self.keep > 0:
+                import shutil
+
+                for old in self._step_dirs()[: -self.keep]:
+                    if old != step_dir:
+                        shutil.rmtree(old, ignore_errors=True)
+
+        if self.async_save:
+            import threading
+
+            def _guarded():
+                try:
+                    _write()
+                except BaseException as e:  # surfaced by the next wait()
+                    self._error = e
+
+            self._thread = threading.Thread(target=_guarded, daemon=True)
+            self._thread.start()
+        else:
+            _write()
+        return step_dir / _CKPT_NAME
+
+    def latest_dir(self) -> Path | None:
+        """Directory of the newest complete checkpoint, or None."""
+        ptr = self.ckpt_dir / "latest"
+        if ptr.exists():
+            cand = self.ckpt_dir / ptr.read_text().strip()
+            if (cand / _CKPT_NAME).exists():
+                return cand
+        dirs = [d for d in self._step_dirs() if (d / _CKPT_NAME).exists()]
+        return dirs[-1] if dirs else None
+
+    def restore(self, target: TrainState) -> tuple[TrainState, dict[str, Any]]:
+        """Restore the newest checkpoint (shaped like ``target``)."""
+        self.wait()
+        latest = self.latest_dir()
+        if latest is None:
+            raise FileNotFoundError(f"no checkpoint under {self.ckpt_dir}")
+        return load_checkpoint(latest, target)
+
+    def close(self) -> None:
+        self.wait()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def save_params(path: str | os.PathLike, params) -> Path | None:

@@ -59,6 +59,8 @@ class TrainConfig:
     eval_at_end: bool = True
     eval_every_epochs: int = 0  # 0 = only at end
     ckpt_dir: str = "./checkpoints"
+    ckpt_keep: int = 3       # retained step checkpoints (0 = keep all)
+    ckpt_async: bool = True  # write checkpoints on a worker thread
     resume: bool = False
     profile_dir: str | None = None  # enable jax.profiler traces when set
     pallas_xent: bool = False  # fused Pallas softmax-xent kernel (TPU)

@@ -117,6 +117,10 @@ class Trainer:
         self.start_epoch = 0
         self.meter = ThroughputMeter(warmup_steps=2)
 
+        self.ckpt_mgr = ckpt_lib.CheckpointManager(
+            cfg.train.ckpt_dir, keep=cfg.train.ckpt_keep,
+            async_save=cfg.train.ckpt_async,
+        )
         if cfg.train.resume:
             self._maybe_resume()
 
@@ -163,13 +167,15 @@ class Trainer:
         start fresh).
         """
         cfg = self.cfg
-        exists = ckpt_lib.checkpoint_exists(cfg.train.ckpt_dir)
+        # Newest manager checkpoint, else the flat pre-manager layout.
+        resume_dir = self.ckpt_mgr.latest_dir()
+        if resume_dir is None and ckpt_lib.checkpoint_exists(cfg.train.ckpt_dir):
+            resume_dir = cfg.train.ckpt_dir
+        exists = resume_dir is not None
         if self.ctx.process_count == 1:
             if not exists:
                 return
-            self.state, meta = ckpt_lib.load_checkpoint(
-                cfg.train.ckpt_dir, self.state
-            )
+            self.state, meta = ckpt_lib.load_checkpoint(resume_dir, self.state)
             self.start_epoch = int(meta.get("epoch", -1)) + 1
         else:
             from jax.experimental import multihost_utils
@@ -180,9 +186,7 @@ class Trainer:
             if not exists0:
                 return
             if self.ctx.process_index == 0:
-                state, meta = ckpt_lib.load_checkpoint(
-                    cfg.train.ckpt_dir, self.state
-                )
+                state, meta = ckpt_lib.load_checkpoint(resume_dir, self.state)
                 epoch = np.int32(int(meta.get("epoch", -1)))
             else:
                 state, epoch = self.state, np.int32(-1)
@@ -190,7 +194,7 @@ class Trainer:
             self.state = multihost_utils.broadcast_one_to_all(host_state)
             self.start_epoch = int(multihost_utils.broadcast_one_to_all(epoch)) + 1
         log0("resumed from %s at epoch %d (step %d)",
-             cfg.train.ckpt_dir, self.start_epoch, int(self.state.step))
+             resume_dir, self.start_epoch, int(self.state.step))
 
     @property
     def global_batch_size(self) -> int:
@@ -265,26 +269,31 @@ class Trainer:
         )
         t0 = time.perf_counter()
         history = []
-        with profile_trace(cfg.train.profile_dir):
-            for epoch in range(self.start_epoch, cfg.train.epochs):
-                stats = self.train_epoch(epoch)
-                history.append(stats)
-                log0("epoch %d: train loss %.4f acc %.4f (%.1f img/s)",
-                     epoch + 1, stats["loss"], stats["accuracy"],
-                     self.meter.images_per_sec)
-                self._log_metrics({"epoch": epoch + 1, **stats,
-                                   "images_per_sec":
-                                       round(self.meter.images_per_sec, 1)})
-                ckpt_lib.save_checkpoint(
-                    cfg.train.ckpt_dir, self.state,
-                    {"epoch": epoch, "config": cfg.to_dict(),
-                     "seed": cfg.train.seed},
-                )
-                every = cfg.train.eval_every_epochs
-                if every and (epoch + 1) % every == 0:
-                    ev = self.evaluate()
-                    log0("epoch %d: eval loss %.4f acc %.4f",
-                         epoch + 1, ev["loss"], ev["accuracy"])
+        try:
+            with profile_trace(cfg.train.profile_dir):
+                for epoch in range(self.start_epoch, cfg.train.epochs):
+                    stats = self.train_epoch(epoch)
+                    history.append(stats)
+                    log0("epoch %d: train loss %.4f acc %.4f (%.1f img/s)",
+                         epoch + 1, stats["loss"], stats["accuracy"],
+                         self.meter.images_per_sec)
+                    self._log_metrics({"epoch": epoch + 1, **stats,
+                                       "images_per_sec":
+                                           round(self.meter.images_per_sec, 1)})
+                    self.ckpt_mgr.save(
+                        self.state,
+                        {"epoch": epoch, "config": cfg.to_dict(),
+                         "seed": cfg.train.seed},
+                    )
+                    every = cfg.train.eval_every_epochs
+                    if every and (epoch + 1) % every == 0:
+                        ev = self.evaluate()
+                        log0("epoch %d: eval loss %.4f acc %.4f",
+                             epoch + 1, ev["loss"], ev["accuracy"])
+        finally:
+            # Join any in-flight async write even when training aborts —
+            # the freshest checkpoint is exactly what a crash-restart needs.
+            self.ckpt_mgr.close()
         print0("Finished Training")  # `cifar_example.py:90` parity
         wall = time.perf_counter() - t0
 
