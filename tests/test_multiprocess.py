@@ -6,8 +6,10 @@ two OS processes bootstrap via `jax.distributed.initialize`, build a shared
 2-device mesh (1 CPU device each), feed *disjoint host shards* of the global
 batch (`make_array_from_process_local_data`), and run the compiled DP train
 step. Asserts: identical loss on both ranks (replicated output), identical
-updated params (replica lockstep — the DDP guarantee), and disjoint sampler
-shards.
+updated params (replica lockstep — the DDP guarantee), disjoint sampler
+shards, and — the reference's own correctness signal (SURVEY.md §3.5) — that
+the 2-process trajectory equals a single-process run on the concatenated
+global batches, across a real process boundary.
 """
 
 import os
@@ -50,9 +52,13 @@ state = create_train_state(model, jax.random.PRNGKey(0),
                            np.zeros((1, 32, 32, 3), np.float32), opt)
 step = make_train_step(model, opt, mesh, constant_lr(0.05))
 
-local = {"image": normalize(ds.images[idx[:8]]), "label": ds.labels[idx[:8]]}
-batch = shard_batch(local, mesh)  # assembles the 16-example global batch
-state, metrics = step(state, batch)
+losses = []
+for k in range(2):  # two steps through this rank's shard
+    sel = idx[k * 8:(k + 1) * 8]
+    local = {"image": normalize(ds.images[sel]), "label": ds.labels[sel]}
+    batch = shard_batch(local, mesh)  # assembles the 16-example global batch
+    state, metrics = step(state, batch)
+    losses.append(float(metrics["loss"]))
 
 # Params are replicated; a jitted scalar digest is identical on every
 # process iff the replicas are in lockstep.
@@ -60,9 +66,10 @@ import jax.numpy as jnp
 digest_fn = jax.jit(lambda p: sum(
     jnp.abs(l).sum() for l in jax.tree_util.tree_leaves(p)))
 param_digest = float(digest_fn(state.params))
-result = dict(rank=rank, loss=float(metrics["loss"]),
+host_params = jax.tree_util.tree_map(np.asarray, state.params)
+result = dict(rank=rank, loss=losses[-1], losses=losses,
               count=int(metrics["count"]), idx=idx.tolist(),
-              param_digest=param_digest)
+              param_digest=param_digest, params=host_params)
 with open(out_path, "wb") as f:
     pickle.dump(result, f)
 jax.distributed.shutdown()
@@ -109,6 +116,41 @@ def test_two_process_dp_train_step(tmp_path):
     assert results[0]["param_digest"] == pytest.approx(
         results[1]["param_digest"], rel=1e-6
     )
+
+    # Single-process oracle (SURVEY.md §3.5): one process, one device,
+    # trained on the concatenated global batches in device order, must
+    # reproduce the 2-process trajectory — the DDP-equivalence property
+    # across a real process boundary, not just an in-process mesh.
+    import jax
+
+    from tpu_dp.data.cifar import make_synthetic, normalize
+    from tpu_dp.models import Net
+    from tpu_dp.parallel import dist
+    from tpu_dp.train import SGD, constant_lr, create_train_state, make_train_step
+
+    ds = make_synthetic(32, 10, seed=0, name="mp")
+    idx0 = np.asarray(results[0]["idx"])
+    idx1 = np.asarray(results[1]["idx"])
+    mesh1 = dist.data_mesh(num_devices=1)
+    model, opt = Net(), SGD(0.9)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32), opt
+    )
+    step = make_train_step(model, opt, mesh1, constant_lr(0.05))
+    oracle_losses = []
+    for k in range(2):
+        sel = np.concatenate([idx0[k * 8:(k + 1) * 8], idx1[k * 8:(k + 1) * 8]])
+        batch = {"image": normalize(ds.images[sel]), "label": ds.labels[sel]}
+        state, metrics = step(state, batch)
+        oracle_losses.append(float(metrics["loss"]))
+    np.testing.assert_allclose(
+        np.asarray(results[0]["losses"]), np.asarray(oracle_losses), rtol=1e-5
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(results[0]["params"]),
+        jax.tree_util.tree_leaves(state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
 @pytest.mark.slow

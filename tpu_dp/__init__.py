@@ -12,7 +12,11 @@ reference (`/root/reference/cifar_example.py` vs `cifar_example_ddp.py`).
 """
 
 from tpu_dp import config, data, metrics, models, ops, parallel, train, utils
-from tpu_dp.checkpoint import load_checkpoint, save_checkpoint
+from tpu_dp.checkpoint import (
+    CheckpointManager,
+    load_checkpoint,
+    save_checkpoint,
+)
 from tpu_dp.config import Config
 from tpu_dp.parallel import dist
 from tpu_dp.train.state import TrainState
@@ -20,6 +24,7 @@ from tpu_dp.train.state import TrainState
 __version__ = "0.1.0"
 
 __all__ = [
+    "CheckpointManager",
     "Config",
     "TrainState",
     "checkpoint",

@@ -96,7 +96,10 @@ class CheckpointManager:
       in-flight write (called automatically before the next save and by
       ``close()``).
 
-    Process-0-only like the base functions; other processes no-op.
+    Saves are process-0-only like the base functions (other processes
+    no-op). ``latest_dir``/``restore`` read whatever disk *this* process
+    sees — on a pod where each host has its own disk, call them from
+    process 0 and broadcast the result (as ``Trainer._maybe_resume`` does).
     """
 
     def __init__(self, ckpt_dir: str | os.PathLike, keep: int = 3,
@@ -110,9 +113,11 @@ class CheckpointManager:
     def _step_dirs(self) -> list[Path]:
         if not self.ckpt_dir.exists():
             return []
+        import re
+
         dirs = [
             p for p in self.ckpt_dir.iterdir()
-            if p.is_dir() and p.name.startswith("step_")
+            if p.is_dir() and re.fullmatch(r"step_\d+", p.name)
         ]
         return sorted(dirs, key=lambda p: int(p.name.split("_")[1]))
 
@@ -178,7 +183,12 @@ class CheckpointManager:
             cand = self.ckpt_dir / ptr.read_text().strip()
             if (cand / _CKPT_NAME).exists():
                 return cand
-        dirs = [d for d in self._step_dirs() if (d / _CKPT_NAME).exists()]
+        # A complete save always has both files; a torn write (crash between
+        # the two renames) must never be resumed from.
+        dirs = [
+            d for d in self._step_dirs()
+            if (d / _CKPT_NAME).exists() and (d / _META_NAME).exists()
+        ]
         return dirs[-1] if dirs else None
 
     def restore(self, target: TrainState) -> tuple[TrainState, dict[str, Any]]:

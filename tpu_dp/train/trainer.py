@@ -293,7 +293,17 @@ class Trainer:
         finally:
             # Join any in-flight async write even when training aborts —
             # the freshest checkpoint is exactly what a crash-restart needs.
-            self.ckpt_mgr.close()
+            # If an exception is already propagating, a checkpoint failure
+            # must not replace it: log and let the original surface.
+            import sys
+
+            try:
+                self.ckpt_mgr.close()
+            except RuntimeError:
+                if sys.exc_info()[0] is None:
+                    raise
+                log0("checkpoint write failed during abort (original "
+                     "exception propagates)", exc_info=True)
         print0("Finished Training")  # `cifar_example.py:90` parity
         wall = time.perf_counter() - t0
 

@@ -33,10 +33,10 @@ def get_logger() -> logging.Logger:
     return _logger
 
 
-def log0(msg: str, *args) -> None:
-    """Log from process 0 only."""
+def log0(msg: str, *args, **kwargs) -> None:
+    """Log from process 0 only (kwargs pass through, e.g. exc_info)."""
     if jax.process_index() == 0:
-        get_logger().info(msg, *args)
+        get_logger().info(msg, *args, **kwargs)
 
 
 def print0(*args, **kwargs) -> None:
