@@ -13,7 +13,9 @@ import time
 
 class ThroughputMeter:
     def __init__(self, warmup_steps: int = 2):
-        self.warmup_steps = warmup_steps
+        # The measurement window opens at the warmup-th step's dispatch, so
+        # at least one step must be excluded — a rate needs a start stamp.
+        self.warmup_steps = max(1, warmup_steps)
         self.reset()
 
     def reset(self) -> None:
