@@ -183,3 +183,49 @@ def test_checkpoint_manager_async_failure_surfaces(tmp_path):
     mgr.save(state, step=1)
     with pytest.raises(RuntimeError, match="async checkpoint write"):
         mgr.wait()
+
+
+def test_config_file_roundtrip(tmp_path):
+    """--config reloads a to_dict dump or checkpoint meta.json exactly."""
+    import json
+
+    src = parse_cli(["--preset=resnet18_cifar10", "--train.epochs=7"])
+    plain = tmp_path / "cfg.json"
+    plain.write_text(json.dumps(src.to_dict()))
+    loaded = parse_cli([f"--config={plain}"])
+    assert loaded.to_dict() == src.to_dict()
+
+    # Checkpoint meta layout: the config sits under a "config" key, and a
+    # checkpoint-destination decision is mandatory (writing into the source
+    # run's ckpt_dir would prune the checkpoints being reproduced).
+    meta = tmp_path / "meta.json"
+    meta.write_text(json.dumps({"epoch": 3, "config": src.to_dict()}))
+    from_meta = parse_cli([f"--config={meta}", "--optim.lr=0.2",
+                           "--train.ckpt_dir=/tmp/newrun"])
+    assert from_meta.optim.lr == 0.2
+    assert from_meta.train.epochs == 7
+    with pytest.raises(ValueError, match="ckpt_dir"):
+        parse_cli([f"--config={meta}"])
+
+    # The parallel section is environment, not experiment: never restored.
+    src.parallel.coordinator_address = "10.0.0.1:8476"
+    src.parallel.process_id = 1
+    stale = tmp_path / "stale.json"
+    stale.write_text(json.dumps(src.to_dict()))
+    fresh = parse_cli([f"--config={stale}"])
+    assert fresh.parallel.coordinator_address is None
+    assert fresh.parallel.process_id is None
+
+    # Values are type-checked/coerced: hand-edited strings cannot silently
+    # flip booleans, and JSON float-ified ints come back as ints.
+    c = Config.from_dict({"model": {"bf16": "false"}, "train": {"epochs": 3.0}})
+    assert c.model.bf16 is False and c.train.epochs == 3
+
+    with pytest.raises(ValueError):
+        parse_cli([f"--config={plain}", "--preset=reference"])
+    with pytest.raises(ValueError):
+        Config.from_dict({"nonexistent_section": {}})
+    with pytest.raises(ValueError):
+        Config.from_dict({"optim": {"nonexistent": 1}})
+    with pytest.raises(ValueError):
+        Config.from_dict({"optim": 5})

@@ -96,6 +96,40 @@ class Config:
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
 
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Config":
+        """Rebuild a Config from `to_dict` output (e.g. checkpoint meta).
+
+        Unknown sections/fields raise, and values are type-checked/coerced
+        against the field defaults — a silently-dropped or silently-mistyped
+        setting would make a "reproduced" run quietly diverge from the
+        original (e.g. the string ``"false"`` loading as truthy).
+        """
+        cfg = cls()
+        for section_name, fields in d.items():
+            if not hasattr(cfg, section_name):
+                raise ValueError(f"unknown config section {section_name!r}")
+            if not isinstance(fields, dict):
+                raise ValueError(
+                    f"config section {section_name!r} must be an object, "
+                    f"got {type(fields).__name__}"
+                )
+            section = getattr(cfg, section_name)
+            for field_name, value in fields.items():
+                if not hasattr(section, field_name):
+                    raise ValueError(
+                        f"unknown field {field_name!r} in {section_name}"
+                    )
+                current = getattr(section, field_name)
+                if isinstance(value, str) and not isinstance(current, str) \
+                        and current is not None:
+                    value = _coerce(value, current)
+                elif (isinstance(current, int) and not isinstance(current, bool)
+                        and isinstance(value, float) and value.is_integer()):
+                    value = int(value)  # JSON round-trips may float-ify ints
+                setattr(section, field_name, value)
+        return cfg
+
 
 def _coerce(value: str, current: Any):
     if isinstance(current, bool):
@@ -171,21 +205,56 @@ PRESETS = {
 
 
 def parse_cli(argv: Sequence[str]) -> Config:
-    """`--preset=name` then any number of `--section.field=value` overrides."""
+    """`--preset=name` / `--config=file.json`, then `--section.field=value`.
+
+    ``--config`` loads a JSON config file — either a bare `to_dict` dump or
+    checkpoint metadata (`meta.json`, whose ``config`` key is used), so a
+    run is reproducible straight from its checkpoint:
+    ``train.py --config=.../step_0000000042/meta.json --train.ckpt_dir=NEW``.
+    The ``parallel`` section is *not* restored — coordinator address and
+    process ids describe the original launch environment, not the
+    experiment, and would hang or collide a new launch. Reproducing from
+    checkpoint meta additionally requires an explicit
+    ``--train.ckpt_dir``/``--train.resume`` decision: writing (and pruning)
+    inside the source run's checkpoint directory would destroy the very
+    checkpoints being reproduced.
+    ``--preset``/``--config`` are mutually exclusive; overrides apply last.
+    """
     cfg: Config | None = None
+    from_meta = False
     overrides: list[tuple[str, str]] = []
     for arg in argv:
         if not arg.startswith("--"):
             raise ValueError(f"unexpected argument {arg!r}")
         key, _, value = arg[2:].partition("=")
+        if key in ("preset", "config") and cfg is not None:
+            raise ValueError("give at most one of --preset / --config")
         if key == "preset":
             if value not in PRESETS:
                 raise ValueError(
                     f"unknown preset {value!r}; available: {sorted(PRESETS)}"
                 )
             cfg = PRESETS[value]()
+        elif key == "config":
+            import json
+            from pathlib import Path
+
+            payload = json.loads(Path(value).read_text())
+            if "config" in payload and isinstance(payload["config"], dict):
+                payload = payload["config"]  # checkpoint meta.json layout
+                from_meta = True
+            payload.pop("parallel", None)  # environment, not experiment
+            cfg = Config.from_dict(payload)
         else:
             overrides.append((key, value))
+    if from_meta and not any(
+        k in ("train.ckpt_dir", "train.resume") for k, _ in overrides
+    ):
+        raise ValueError(
+            "reproducing from checkpoint meta.json writes checkpoints; pass "
+            "--train.ckpt_dir=<new dir> (fresh reproduction) or "
+            "--train.resume=true (continue in place) explicitly"
+        )
     cfg = cfg or Config()
     for key, value in overrides:
         cfg.override(key, value)

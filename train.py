@@ -12,6 +12,8 @@ Usage:
     python train.py --preset=resnet18_cifar10
     python train.py --preset=bf16_cosine_gb4096 --train.epochs=5
     python train.py --data.dataset=synthetic --train.log_every=50
+    python train.py --config=checkpoints/step_0000000042/meta.json \
+        --train.ckpt_dir=./repro   # reproduce into a fresh checkpoint dir
 
 Any config field is overridable as `--section.field=value` (see
 `tpu_dp/config.py`).
