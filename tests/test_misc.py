@@ -206,6 +206,9 @@ def test_config_file_roundtrip(tmp_path):
     assert from_meta.train.epochs == 7
     with pytest.raises(ValueError, match="ckpt_dir"):
         parse_cli([f"--config={meta}"])
+    with pytest.raises(ValueError, match="ckpt_dir"):
+        # resume=false is not a destination decision; the gate must hold.
+        parse_cli([f"--config={meta}", "--train.resume=false"])
 
     # The parallel section is environment, not experiment: never restored.
     src.parallel.coordinator_address = "10.0.0.1:8476"
@@ -229,3 +232,17 @@ def test_config_file_roundtrip(tmp_path):
         Config.from_dict({"optim": {"nonexistent": 1}})
     with pytest.raises(ValueError):
         Config.from_dict({"optim": 5})
+    with pytest.raises(ValueError, match="expected int"):
+        Config.from_dict({"train": {"epochs": True}})
+    with pytest.raises(ValueError, match="expected bool"):
+        Config.from_dict({"model": {"bf16": 1}})
+    with pytest.raises(ValueError, match="scalar"):
+        Config.from_dict({"model": {"num_classes": [10]}})
+
+
+def test_dist_describe_topology(mesh8):
+    d = dist.describe(mesh8)
+    assert d["devices"] == 8 and d["processes"] == 1
+    assert d["local_devices"] >= 1 and d["host_cpus"] >= 1
+    assert isinstance(d["host"], str) and d["host"]
+    assert d["platform"] == "cpu"

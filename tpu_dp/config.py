@@ -58,6 +58,10 @@ class TrainConfig:
     seed: int = 0
     eval_at_end: bool = True
     eval_every_epochs: int = 0  # 0 = only at end
+    # Steps fused into one device dispatch via the scanned loop (1 = the
+    # plain per-step path). Amortizes launch latency; requires
+    # grad_accum_steps == 1. The epoch's trailing steps run per-step.
+    steps_per_call: int = 1
     ckpt_dir: str = "./checkpoints"
     ckpt_keep: int = 3       # retained step checkpoints (0 = keep all)
     ckpt_async: bool = True  # write checkpoints on a worker thread
@@ -127,8 +131,33 @@ class Config:
                 elif (isinstance(current, int) and not isinstance(current, bool)
                         and isinstance(value, float) and value.is_integer()):
                     value = int(value)  # JSON round-trips may float-ify ints
+                _check_field_type(section_name, field_name, current, value)
                 setattr(section, field_name, value)
         return cfg
+
+
+def _check_field_type(section: str, name: str, current: Any, value: Any):
+    """Reject mistyped config values (bool-for-int, list-for-scalar, ...).
+
+    Defaults define the schema: a value must match its field's default type
+    (int accepted where float is expected; fields defaulting to None accept
+    any JSON scalar)."""
+    where = f"{section}.{name}"
+    if current is None or value is None:
+        if isinstance(value, (dict, list)):
+            raise ValueError(f"{where}: expected a scalar, got {value!r}")
+        return
+    if isinstance(current, bool) or isinstance(value, bool):
+        if not (isinstance(current, bool) and isinstance(value, bool)):
+            raise ValueError(f"{where}: expected {type(current).__name__}, "
+                             f"got {value!r}")
+        return
+    if isinstance(current, int) and not isinstance(value, int):
+        raise ValueError(f"{where}: expected int, got {value!r}")
+    if isinstance(current, float) and not isinstance(value, (int, float)):
+        raise ValueError(f"{where}: expected float, got {value!r}")
+    if isinstance(current, str) and not isinstance(value, str):
+        raise ValueError(f"{where}: expected str, got {value!r}")
 
 
 def _coerce(value: str, current: Any):
@@ -247,9 +276,12 @@ def parse_cli(argv: Sequence[str]) -> Config:
             cfg = Config.from_dict(payload)
         else:
             overrides.append((key, value))
-    if from_meta and not any(
-        k in ("train.ckpt_dir", "train.resume") for k, _ in overrides
-    ):
+    resume_on = any(
+        k == "train.resume" and v.lower() in ("1", "true", "yes", "on")
+        for k, v in overrides
+    )
+    new_ckpt_dir = any(k == "train.ckpt_dir" for k, _ in overrides)
+    if from_meta and not (new_ckpt_dir or resume_on):
         raise ValueError(
             "reproducing from checkpoint meta.json writes checkpoints; pass "
             "--train.ckpt_dir=<new dir> (fresh reproduction) or "

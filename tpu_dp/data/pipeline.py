@@ -129,16 +129,17 @@ class DataPipeline:
         spec = P(DATA_AXIS) if self.accum_steps == 1 else P(None, DATA_AXIS)
         return shard_batch(batch, self.mesh, spec=spec)
 
-    def __iter__(self):
+    def _prefetched(self, placed_items):
+        """Drain `placed_items` through the bounded background prefetcher.
+
+        The producer stages the next `prefetch` items onto the devices while
+        the consumer's step executes. Early-exit safe: a stop flag unblocks
+        the producer if the consumer abandons the iterator mid-epoch.
+        """
         if self.prefetch <= 0:
-            for b in self._host_batches():
-                yield self._place(b)
+            yield from placed_items
             return
 
-        # Bounded background prefetch: the producer stages the next
-        # `prefetch` batches onto the devices while the consumer's step
-        # executes. Early-exit safe: a stop flag unblocks the producer if
-        # the consumer abandons the iterator mid-epoch.
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
 
@@ -153,8 +154,8 @@ class DataPipeline:
 
         def _producer():
             try:
-                for b in self._host_batches():
-                    if not _put(self._place(b)):
+                for item in placed_items:
+                    if not _put(item):
                         return
                 _put(_END)
             except BaseException as e:  # surface in the consumer
@@ -174,3 +175,44 @@ class DataPipeline:
                 yield item
         finally:
             stop.set()
+
+    def __iter__(self):
+        return self._prefetched(self._place(b) for b in self._host_batches())
+
+    def windows(self, k: int):
+        """Yield ``(n_steps, device_item)`` pairs for `make_multi_step`.
+
+        Full windows stack ``k`` consecutive host batches on a leading scan
+        axis (one host→device transfer, one dispatch for ``k`` optimizer
+        steps); the epoch's trailing ``len(self) % k`` batches yield as
+        ``(1, batch)`` singles for the per-step path — the scanned loop is
+        compiled for a fixed window, and padding an optimizer-update window
+        would train on fabricated steps. Requires the training pipeline
+        shape: ``accum_steps == 1`` and ``drop_remainder=True`` (windows
+        carry no weight masks).
+        """
+        k = int(k)
+        if k <= 1:
+            yield from ((1, b) for b in self)
+            return
+        if self.accum_steps != 1:
+            raise ValueError("windows(k) requires accum_steps == 1")
+        if not self.drop_remainder:
+            raise ValueError("windows(k) requires drop_remainder=True")
+
+        def _host_items():
+            buf = []
+            for b in self._host_batches():
+                buf.append(b)
+                if len(buf) == k:
+                    pool = {
+                        key: np.stack([bb[key] for bb in buf])
+                        for key in buf[0]
+                    }
+                    yield (k, shard_batch(pool, self.mesh,
+                                          spec=P(None, DATA_AXIS)))
+                    buf = []
+            for b in buf:
+                yield (1, self._place(b))
+
+        return (yield from self._prefetched(_host_items()))

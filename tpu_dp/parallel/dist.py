@@ -181,3 +181,27 @@ def barrier(mesh: Mesh | None = None) -> None:
     total = int(jax.jit(lambda x: x.sum())(ones))
     if total != n:
         raise RuntimeError(f"barrier psum returned {total}, expected {n}")
+
+
+def describe(mesh: Mesh | None = None) -> dict:
+    """Topology summary for startup logs and diagnostics.
+
+    The observability the reference leaves implicit in torchrun env vars
+    (`cifar_example_ddp.py:43-45`): what hardware this run actually spans.
+    Combines JAX device introspection with the native host library's
+    cpu/hostname queries (`tpu_dp.ops.native`).
+    """
+    from tpu_dp.ops.native import cpu_count, hostname
+
+    devices = list(mesh.devices.flat) if mesh is not None else jax.devices()
+    kinds = sorted({d.device_kind for d in devices})
+    return {
+        "devices": len(devices),
+        "device_kind": kinds[0] if len(kinds) == 1 else kinds,
+        "platform": devices[0].platform if devices else None,
+        "processes": process_count(),
+        "process_index": process_index(),
+        "local_devices": local_device_count(),
+        "host": hostname(),
+        "host_cpus": cpu_count(),
+    }

@@ -54,6 +54,7 @@ class Trainer:
             num_devices=cfg.parallel.num_devices
         )
         self.num_devices = int(self.mesh.devices.size)
+        log0("topology: %s", json.dumps(dist.describe(self.mesh)))
 
         self._load_data(cfg)
 
@@ -110,6 +111,25 @@ class Trainer:
             augment_fn=augment_fn,
         )
         self.eval_step = make_eval_step(self.model, self.mesh)
+        self.steps_per_call = max(1, int(cfg.train.steps_per_call))
+        if self.steps_per_call > 1 and cfg.optim.grad_accum_steps > 1:
+            raise ValueError(
+                "train.steps_per_call > 1 requires optim.grad_accum_steps == 1"
+            )
+        if self.steps_per_call > 1 and not cfg.data.drop_remainder:
+            raise ValueError(
+                "train.steps_per_call > 1 requires data.drop_remainder=true"
+            )
+        self.multi_step = None
+        if self.steps_per_call > 1:
+            from tpu_dp.train.step import make_multi_step
+
+            self.multi_step = make_multi_step(
+                self.model, self.optimizer, self.mesh, self.schedule,
+                num_steps=self.steps_per_call,
+                use_pallas_xent=cfg.train.pallas_xent,
+                augment_fn=augment_fn,
+            )
 
         rng = jax.random.PRNGKey(cfg.train.seed)
         sample = np.zeros((1, 32, 32, 3), np.float32)
@@ -210,24 +230,40 @@ class Trainer:
         run_loss, run_steps = None, 0  # device-side running-loss accumulator
         ep_loss = ep_correct = None
         ep_steps, ep_count = 0, 0
-        for i, batch in enumerate(self.train_pipe):
-            self.state, m = self.train_step(self.state, batch)
-            # On-device async adds; no host sync inside the loop.
-            run_loss = m["loss"] if run_loss is None else run_loss + m["loss"]
-            run_steps += 1
-            ep_loss = m["loss"] if ep_loss is None else ep_loss + m["loss"]
-            ep_correct = (
-                m["correct"] if ep_correct is None else ep_correct + m["correct"]
-            )
-            ep_steps += 1
-            ep_count += gbs
-            self.meter.step(gbs)
-            if i % cfg.train.log_every == cfg.train.log_every - 1:
-                # Reference print format (`cifar_example.py:85-86`); the
-                # float() here is the only sync per log interval.
-                print0("[%d, %5d] loss: %.3f"
-                       % (epoch + 1, i + 1, float(run_loss) / run_steps))
-                run_loss, run_steps = None, 0
+        i = -1
+        for n, item in self.train_pipe.windows(self.steps_per_call):
+            if n == 1:
+                self.state, m = self.train_step(self.state, item)
+                window = (m,)
+            else:
+                # One dispatch, n optimizer steps (device-side scanned
+                # loop); stacked metrics index lazily below — still no
+                # host sync outside log boundaries.
+                self.state, stacked = self.multi_step(self.state, item)
+                window = tuple(
+                    {k: v[j] for k, v in stacked.items()} for j in range(n)
+                )
+            for m in window:
+                i += 1
+                # On-device async adds; no host sync inside the loop.
+                run_loss = (
+                    m["loss"] if run_loss is None else run_loss + m["loss"]
+                )
+                run_steps += 1
+                ep_loss = m["loss"] if ep_loss is None else ep_loss + m["loss"]
+                ep_correct = (
+                    m["correct"] if ep_correct is None
+                    else ep_correct + m["correct"]
+                )
+                ep_steps += 1
+                ep_count += gbs
+                self.meter.step(gbs)
+                if i % cfg.train.log_every == cfg.train.log_every - 1:
+                    # Reference print format (`cifar_example.py:85-86`); the
+                    # float() here is the only sync per log interval.
+                    print0("[%d, %5d] loss: %.3f"
+                           % (epoch + 1, i + 1, float(run_loss) / run_steps))
+                    run_loss, run_steps = None, 0
         stats = {
             "loss": float(ep_loss) / max(1, ep_steps) if ep_steps else 0.0,
             "accuracy": float(ep_correct) / ep_count if ep_count else 0.0,
