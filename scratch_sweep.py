@@ -1,0 +1,51 @@
+"""TPU perf experiments (scratch, not part of the framework).
+
+Run when the TPU relay is live: sweeps per-chip batch and loss impl through
+the scanned-window measurement bench.py uses. Usage:
+    python scratch_sweep.py 1024 2048 4096     # batch sizes to try
+    PALLAS=1 python scratch_sweep.py 2048      # fused pallas xent loss
+"""
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dp.data.cifar import make_synthetic
+from tpu_dp.models import ResNet18
+from tpu_dp.parallel import dist
+from tpu_dp.parallel.sharding import scan_batch_sharding, shard_batch
+from tpu_dp.train import SGD, cosine_lr, create_train_state, make_multi_step
+
+mesh = dist.data_mesh()
+n = int(mesh.devices.size)
+STEPS = 30
+use_pallas = os.environ.get("PALLAS", "0") == "1"
+
+for batch in [int(a) for a in sys.argv[1:]] or (2048,):
+    model = ResNet18(num_classes=10, dtype=jnp.bfloat16)
+    opt = SGD(momentum=0.9, weight_decay=5e-4)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32), opt
+    )
+    loop = make_multi_step(
+        model, opt, mesh, cosine_lr(0.4, 2 * STEPS, 2), num_steps=STEPS,
+        use_pallas_xent=use_pallas,
+    )
+    pool_ds = [make_synthetic(batch * n, 10, seed=i, name="bench") for i in range(4)]
+    pool = shard_batch(
+        {"image": np.stack([d.images for d in pool_ds]),
+         "label": np.stack([d.labels for d in pool_ds])},
+        mesh, spec=scan_batch_sharding(mesh),
+    )
+    state, m = loop(state, pool)
+    float(m["loss"][-1])  # fence (axon relay: block_until_ready lies)
+    t0 = time.perf_counter()
+    state, m = loop(state, pool)
+    float(m["loss"][-1])
+    dt = time.perf_counter() - t0
+    ips = STEPS * batch * n / dt / n
+    print(f"batch/chip={batch} pallas={use_pallas}: {ips:.0f} img/s/chip "
+          f"({dt / STEPS * 1e3:.1f} ms/step)", flush=True)
