@@ -1,0 +1,117 @@
+"""bench.py harness logic — the parts that must work during a TPU outage.
+
+The measurement itself needs an accelerator; what these tests pin down is
+the outage machinery: device-kind→peak mapping, the self-archive fallback
+(most recent non-cpu result wins; nulls and cpu smoke runs are skipped),
+and subprocess output parsing — the round-1 failure mode was a bench that
+let a relay outage erase the round's number (VERDICT.md "What's weak" #2).
+"""
+
+import json
+
+import bench
+
+
+def test_peak_flops_known_kinds():
+    assert bench.peak_flops("TPU v5 lite") == 197e12
+    assert bench.peak_flops("TPU v5e") == 197e12
+    assert bench.peak_flops("TPU v5p") == 459e12
+    assert bench.peak_flops("TPU v4") == 275e12
+    assert bench.peak_flops("TPU v3") == 123e12
+    assert bench.peak_flops("TPU v6 lite") == 918e12
+
+
+def test_peak_flops_v5_lite_not_misread_as_v5p():
+    # Substring order matters: "v5 lite" must match before bare "v5".
+    assert bench.peak_flops("tpu v5 lite") == 197e12
+
+
+def test_peak_flops_unknown_is_none():
+    assert bench.peak_flops("cpu") is None
+    assert bench.peak_flops("Graphcore IPU") is None
+
+
+def _write_archive(tmp_path, records):
+    p = tmp_path / "results.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in records))
+    return p
+
+
+def test_last_good_archived_picks_latest_accelerator_result(tmp_path, monkeypatch):
+    p = _write_archive(tmp_path, [
+        {"metric": bench.METRIC, "value": 30000.0, "unit": bench.UNIT,
+         "vs_baseline": 12.0, "backend": "axon", "ts": "t1"},
+        {"metric": bench.METRIC, "value": 1.5, "unit": bench.UNIT,
+         "vs_baseline": 0.0, "backend": "cpu", "ts": "t2"},       # cpu smoke
+        {"metric": bench.METRIC, "value": None, "unit": bench.UNIT,
+         "vs_baseline": None, "error": "timeout", "ts": "t3"},    # failed point
+    ])
+    monkeypatch.setattr(bench, "RESULTS_PATH", p)
+    rec = bench.last_good_archived()
+    assert rec is not None and rec["value"] == 30000.0 and rec["ts"] == "t1"
+
+
+def test_last_good_archived_best_of_latest_run(tmp_path, monkeypatch):
+    # The fallback must mirror live headline semantics: best point of the
+    # MOST RECENT run — not the globally-best stale number, and not the
+    # last-written line (a sweep ends with deliberately-slow w=1 points).
+    p = _write_archive(tmp_path, [
+        {"metric": bench.METRIC, "value": 40000.0, "unit": bench.UNIT,
+         "vs_baseline": 16.0, "backend": "axon", "ts": "2026-01-01T00:00:00Z"},
+        {"metric": bench.METRIC, "value": 31000.0, "unit": bench.UNIT,
+         "vs_baseline": 12.4, "backend": "axon", "ts": "2026-02-01T00:00:00Z",
+         "config": {"steps_per_call": 30}},
+        {"metric": bench.METRIC, "value": 4000.0, "unit": bench.UNIT,
+         "vs_baseline": 1.6, "backend": "axon", "ts": "2026-02-01T00:00:00Z",
+         "config": {"steps_per_call": 1}},
+    ])
+    monkeypatch.setattr(bench, "RESULTS_PATH", p)
+    rec = bench.last_good_archived()
+    assert rec is not None and rec["value"] == 31000.0
+
+
+def test_last_good_archived_none_on_missing_or_junk(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "RESULTS_PATH", tmp_path / "absent.jsonl")
+    assert bench.last_good_archived() is None
+    p = tmp_path / "junk.jsonl"
+    p.write_text("not json\n{\"value\": null}\n")
+    monkeypatch.setattr(bench, "RESULTS_PATH", p)
+    assert bench.last_good_archived() is None
+
+
+def test_archive_appends(tmp_path, monkeypatch):
+    p = tmp_path / "nested" / "results.jsonl"
+    monkeypatch.setattr(bench, "RESULTS_PATH", p)
+    bench.archive({"a": 1})
+    bench.archive({"b": 2})
+    lines = p.read_text().splitlines()
+    assert [json.loads(x) for x in lines] == [{"a": 1}, {"b": 2}]
+
+
+def test_run_point_reports_child_failure(monkeypatch):
+    # A child that dies without emitting JSON must yield a structured error
+    # record, not an exception.
+    monkeypatch.setattr(
+        bench, "_run_sub", lambda argv, t, env=None: (1, "noise\n", "boom")
+    )
+    rec = bench.run_point({"per_chip_batch": 8}, timeout_s=5)
+    assert rec["value"] is None
+    assert "rc=1" in rec["error"] and "boom" in rec["error"]
+
+
+def test_run_point_parses_last_json_line(monkeypatch):
+    payload = {"metric": bench.METRIC, "value": 123.0, "unit": bench.UNIT,
+               "vs_baseline": 0.05}
+    out = "bench: chatter\n" + json.dumps(payload) + "\n"
+    monkeypatch.setattr(
+        bench, "_run_sub", lambda argv, t, env=None: (0, out, "")
+    )
+    assert bench.run_point({}, timeout_s=5) == payload
+
+
+def test_run_point_timeout(monkeypatch):
+    monkeypatch.setattr(
+        bench, "_run_sub", lambda argv, t, env=None: (124, "", "")
+    )
+    rec = bench.run_point({}, timeout_s=7)
+    assert rec["value"] is None and "timeout" in rec["error"]

@@ -192,13 +192,20 @@ class DataPipeline:
         carry no weight masks).
         """
         k = int(k)
+        # Validate eagerly (this is a plain function returning a generator,
+        # not a generator function) so misconfiguration surfaces at the call
+        # site, not at first iteration.
+        if k > 1:
+            if self.accum_steps != 1:
+                raise ValueError("windows(k) requires accum_steps == 1")
+            if not self.drop_remainder:
+                raise ValueError("windows(k) requires drop_remainder=True")
+        return self._windows_iter(k)
+
+    def _windows_iter(self, k: int):
         if k <= 1:
             yield from ((1, b) for b in self)
             return
-        if self.accum_steps != 1:
-            raise ValueError("windows(k) requires accum_steps == 1")
-        if not self.drop_remainder:
-            raise ValueError("windows(k) requires drop_remainder=True")
 
         def _host_items():
             buf = []

@@ -58,6 +58,18 @@ def cross_entropy_loss(
     return jnp.sum(per_example * weight) / jnp.maximum(jnp.sum(weight), 1.0)
 
 
+def _to_varying(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Cast a replication-invariant value to device-varying under shard_map.
+
+    `jax.lax.pvary` is deprecated in jax 0.9 in favour of
+    `jax.lax.pcast(..., to='varying')`; keep one call site so the next
+    rename is a one-line change.
+    """
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to="varying")
+    return jax.lax.pvary(x, axis_name)
+
+
 def _maybe_normalize(images: jnp.ndarray) -> jnp.ndarray:
     """Fused on-device normalize for uint8 batches (pipeline default).
 
@@ -354,10 +366,11 @@ def make_train_step_shard_map(
         # *invariant* params would get an implicit cross-shard psum inserted
         # by AD (the cotangent of the invariant→varying broadcast) — i.e.
         # globally-summed grads before our explicit collective, which would
-        # overscale the update by the world size. `pvary` keeps AD local:
-        # per-shard grads out, exactly what DDP's reducer sees pre-allreduce.
+        # overscale the update by the world size. Casting params to
+        # *varying* keeps AD local: per-shard grads out, exactly what DDP's
+        # reducer sees pre-allreduce.
         local_params = jax.tree_util.tree_map(
-            lambda p: jax.lax.pvary(p, DATA_AXIS), state.params
+            lambda p: _to_varying(p, DATA_AXIS), state.params
         )
         loss, grads, new_batch_stats, correct = _forward_backward(
             model, cross_entropy_loss, state.replace(params=local_params),

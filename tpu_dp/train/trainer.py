@@ -32,7 +32,6 @@ from tpu_dp import checkpoint as ckpt_lib
 from tpu_dp.config import Config
 from tpu_dp.data.cifar import load_dataset
 from tpu_dp.data.pipeline import DataPipeline
-from tpu_dp.metrics import Accuracy, Mean
 from tpu_dp.models import build_model
 from tpu_dp.parallel import dist
 from tpu_dp.train.optim import SGD
@@ -285,14 +284,29 @@ class Trainer:
             f.write(json.dumps(record) + "\n")
 
     def evaluate(self) -> dict[str, float]:
-        acc = Accuracy()
-        loss = Mean()
+        """Global test accuracy/loss with ONE device→host fetch.
+
+        The per-batch sums stay device-resident (each `+` is an async
+        dispatch, never a sync) — on a high-RTT transport a per-batch
+        `int(...)`/`float(...)` would make eval dispatch-bound, the exact
+        host-sync pattern the train loop avoids.
+        """
+        correct = count = loss_sum = None
         for batch in self.test_pipe:
             m = self.eval_step(self.state, batch)
-            n = int(m["count"])
-            acc.update(m["correct"], n)
-            loss.update(float(m["loss"]), n)
-        return {"accuracy": acc.compute(), "loss": loss.compute()}
+            batch_loss_sum = m["loss"] * m["count"]  # mean → sum, on device
+            if correct is None:
+                correct, count = m["correct"], m["count"]
+                loss_sum = batch_loss_sum
+            else:
+                correct = correct + m["correct"]
+                count = count + m["count"]
+                loss_sum = loss_sum + batch_loss_sum
+        if count is None:
+            return {"accuracy": 0.0, "loss": 0.0}
+        correct, count, loss_sum = jax.device_get((correct, count, loss_sum))
+        n = max(int(count), 1)
+        return {"accuracy": float(correct) / n, "loss": float(loss_sum) / n}
 
     def fit(self) -> dict[str, Any]:
         cfg = self.cfg
@@ -330,13 +344,16 @@ class Trainer:
             # Join any in-flight async write even when training aborts —
             # the freshest checkpoint is exactly what a crash-restart needs.
             # If an exception is already propagating, a checkpoint failure
-            # must not replace it: log and let the original surface.
+            # must not replace it: log and let the original surface. On a
+            # clean run, a failed final write must raise (a checkpoint that
+            # silently failed to persist is worse than a crash).
             import sys
 
+            propagating = sys.exc_info()[0] is not None
             try:
                 self.ckpt_mgr.close()
             except RuntimeError:
-                if sys.exc_info()[0] is None:
+                if not propagating:
                     raise
                 log0("checkpoint write failed during abort (original "
                      "exception propagates)", exc_info=True)
