@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import subprocess
 import sys
@@ -60,6 +61,17 @@ V100_BASELINE_IMG_PER_SEC_PER_CHIP = 2500.0
 METRIC = "cifar10_resnet18_train_images_per_sec_per_chip"
 UNIT = "images/sec/chip"
 RESULTS_PATH = Path(__file__).resolve().parent / "benchmarks" / "results.jsonl"
+
+# Analytic conv+dot FLOPs for one *trained* image, CIFAR ResNet-18
+# (`tpu_dp/models/resnet.py`: 3x3 stem, stages [2,2,2,2] at widths
+# 64/128/256/512 on feature maps 32/16/8/4). Forward MACs: stem 1.77M +
+# stage1 151.0M + stages2-4 134.2M each + fc 5.1K = 555.4M MACs
+# = 1.11 GFLOP forward; training ~= 3x forward (grad wrt weights + wrt
+# activations) = ~3.3 GFLOP, minus the stem's unneeded input-grad and
+# whatever XLA folds away => ~2.9-3.3e9. Used to disambiguate
+# cost_analysis() loop semantics and to sanity-check the published MFU.
+RESNET18_CIFAR_TRAIN_FLOPS_PER_IMAGE = 3.0e9
+FLOPS_CHECK_RTOL = 1.35  # +-35%: covers bwd-pass accounting slop, not 30x
 
 # bf16 peak matmul FLOP/s per chip, by device_kind substring (first match
 # wins; ordered so "v5 lite" is tested before "v5"). Public spec-sheet
@@ -84,6 +96,54 @@ def peak_flops(device_kind: str) -> float | None:
         if sub in kind:
             return peak
     return None
+
+
+def resolve_flops_per_step(program_flops, step_flops, window, per_chip_batch):
+    """Per-optimizer-step per-chip FLOPs for MFU; robust to scan cost semantics.
+
+    All inputs and the result are PER-DEVICE: `compiled.cost_analysis()`
+    reports the SPMD per-device module's FLOPs, MFU divides by one chip's
+    peak, and the analytic yardstick is therefore built from the per-chip
+    batch (using the global batch would mis-resolve on any multi-chip mesh).
+
+    Round 2 published mfu=0.0165 instead of the true ~0.49 because
+    `compiled.cost_analysis()["flops"]` on a `lax.scan` program reports the
+    loop *body's* FLOPs once on this jaxlib/TPU, and the old code divided by
+    the trip count again (VERDICT.md round 2, "What's weak" #1). Resolution
+    order:
+
+    1. `step_flops` — cost analysis of the w1-compiled production step
+       (`make_train_step`), which has no loop and therefore no ambiguity.
+       The scanned w30 point reuses this number, so w1 and w30 publish the
+       same flops_per_step by construction.
+    2. `program_flops` — the scanned program's cost. Whether it is body-only
+       or body x trip-count is version-dependent, so pick the reading
+       (as-is vs /window) closest in log-space to the analytic count.
+    3. The analytic count itself.
+
+    Returns (flops_per_step, source, check) where check is "ok" when the
+    resolved value agrees with the analytic count within FLOPS_CHECK_RTOL,
+    else "mismatch:analytic_ratio=R" — published in the record so a wrong
+    MFU can never again look routine.
+    """
+    analytic = RESNET18_CIFAR_TRAIN_FLOPS_PER_IMAGE * per_chip_batch
+    if step_flops:
+        resolved, source = float(step_flops), "w1_step_cost_analysis"
+    elif program_flops:
+        body = float(program_flops)          # body-reported-once reading
+        divided = float(program_flops) / max(int(window), 1)
+        resolved = min((body, divided),
+                       key=lambda f: abs(math.log(f / analytic)))
+        source = ("scan_cost_analysis_body" if resolved == body
+                  else "scan_cost_analysis_divided")
+    else:
+        # Comparing the analytic estimate against itself would be vacuous:
+        # mark it so consumers can't mistake an estimate for a validation.
+        return analytic, "analytic", "unverified"
+    ratio = resolved / analytic
+    check = ("ok" if 1 / FLOPS_CHECK_RTOL <= ratio <= FLOPS_CHECK_RTOL
+             else f"mismatch:analytic_ratio={ratio:.3g}")
+    return resolved, source, check
 
 
 # --------------------------------------------------------------------------
@@ -230,7 +290,6 @@ def measure_point(cfg: dict) -> dict:
         }
         pool = shard_batch(stacked, mesh, spec=scan_batch_sharding(mesh))
         loop_exe, program_flops = compile_with_flops(loop, state, pool)
-        flops_per_step = (program_flops / window) if program_flops else None
 
         state, metrics = loop_exe(state, pool)  # warmup window
         float(metrics["loss"][-1])
@@ -239,6 +298,7 @@ def measure_point(cfg: dict) -> dict:
         float(metrics["loss"][-1])
         elapsed = time.perf_counter() - t0
         n_steps_timed = window
+        step_flops = None  # resolved below, after the provisional record
     else:
         step = make_train_step(model, opt, mesh, sched,
                                use_pallas_xent=use_pallas)
@@ -247,7 +307,8 @@ def measure_point(cfg: dict) -> dict:
                         spec=batch_sharding(mesh))
             for d in host_pool
         ]
-        step_exe, flops_per_step = compile_with_flops(step, state, batches[0])
+        step_exe, step_flops = compile_with_flops(step, state, batches[0])
+        program_flops = None  # no scan program on this path
 
         state, metrics = step_exe(state, batches[0])  # warmup
         float(metrics["loss"])
@@ -260,32 +321,59 @@ def measure_point(cfg: dict) -> dict:
 
     images_per_sec = n_steps_timed * global_batch / elapsed
     per_chip_ips = images_per_sec / n_chips
-
     device_kind = jax.devices()[0].device_kind
     peak = peak_flops(device_kind)
-    mfu = None
-    if flops_per_step and peak:
-        # cost_analysis reports the per-device SPMD module's FLOPs.
-        mfu = round(flops_per_step * n_steps_timed / elapsed / peak, 4)
 
-    return {
-        "metric": METRIC,
-        "value": round(per_chip_ips, 1),
-        "unit": UNIT,
-        "vs_baseline": round(per_chip_ips / V100_BASELINE_IMG_PER_SEC_PER_CHIP, 3),
-        "mfu": mfu,
-        "ms_per_step": round(elapsed / n_steps_timed * 1e3, 3),
-        "flops_per_step_per_chip": flops_per_step,
-        "backend": jax.default_backend(),
-        "device_kind": device_kind,
-        "n_chips": n_chips,
-        "config": {
-            "model": "resnet18", "dtype": "bfloat16",
-            "per_chip_batch": per_chip, "steps_per_call": window,
-            "measured_steps": n_steps_timed,
-            "xent": "pallas" if use_pallas else "jnp",
-        },
-    }
+    def build(flops_per_step, flops_source, flops_check):
+        mfu = None
+        if flops_per_step and peak:
+            # cost_analysis reports the per-device SPMD module's FLOPs.
+            mfu = round(flops_per_step * n_steps_timed / elapsed / peak, 4)
+        return {
+            "metric": METRIC,
+            "value": round(per_chip_ips, 1),
+            "unit": UNIT,
+            "vs_baseline": round(
+                per_chip_ips / V100_BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+            "mfu": mfu,
+            "ms_per_step": round(elapsed / n_steps_timed * 1e3, 3),
+            "flops_per_step_per_chip": flops_per_step,
+            "flops_source": flops_source,
+            "flops_check": flops_check,
+            "backend": jax.default_backend(),
+            "device_kind": device_kind,
+            "n_chips": n_chips,
+            "config": {
+                "model": "resnet18", "dtype": "bfloat16",
+                "per_chip_batch": per_chip, "steps_per_call": window,
+                "measured_steps": n_steps_timed,
+                "xent": "pallas" if use_pallas else "jnp",
+            },
+        }
+
+    if window > 1:
+        # FLOPs truth comes from the loop-free w1 step (compiled for cost
+        # analysis only) — scan cost semantics are ambiguous; see
+        # resolve_flops_per_step. The compile touches the device, so first
+        # BANK the measurement: emit a provisional record (scan/analytic
+        # FLOPs reading) that run_point's last-JSON-line parse will pick up
+        # even if the relay wedges in the extra compile and the parent has
+        # to kill this child; a clean finish overprints it below.
+        emit(build(*resolve_flops_per_step(
+            program_flops, None, window, per_chip)))
+        try:
+            step = make_train_step(model, opt, mesh, sched,
+                                   use_pallas_xent=use_pallas)
+            single = shard_batch(
+                {"image": host_pool[0].images, "label": host_pool[0].labels},
+                mesh, spec=batch_sharding(mesh))
+            _, step_flops = compile_with_flops(step, state, single)
+        except Exception as e:
+            print(f"bench: w1 cost-analysis compile failed ({e!r}); "
+                  f"keeping scan/analytic FLOPs reading", file=sys.stderr)
+
+    return build(*resolve_flops_per_step(
+        program_flops, step_flops, window, per_chip))
 
 
 # --------------------------------------------------------------------------
@@ -322,7 +410,9 @@ def last_good_archived() -> dict | None:
         return None
     latest_ts = max(r.get("ts", "") for r in good)
     run = [r for r in good if r.get("ts", "") == latest_ts]
-    return max(run, key=lambda r: r["value"])
+    # run_n_points distinguishes a 1-point archive from a full sweep in the
+    # driver artifact when this record is re-emitted stale.
+    return dict(max(run, key=lambda r: r["value"]), run_n_points=len(run))
 
 
 def run_point(cfg: dict, timeout_s: float) -> dict:
@@ -385,6 +475,9 @@ def main() -> None:
             emit({"metric": stale["metric"], "value": stale["value"],
                   "unit": stale["unit"], "vs_baseline": stale["vs_baseline"],
                   "mfu": stale.get("mfu"), "stale": True,
+                  "flops_source": stale.get("flops_source"),
+                  "flops_check": stale.get("flops_check"),
+                  "n_points": stale.get("run_n_points"),
                   "stale_reason": f"device unavailable now ({failure}); "
                                   f"re-emitting archived result from "
                                   f"{stale.get('ts', 'unknown time')}",
@@ -431,7 +524,7 @@ def main() -> None:
               "error": results[0].get("error", "all points failed")})
         sys.exit(0)
     best = max(good, key=lambda r: r["value"])
-    emit(best)
+    emit(dict(best, n_points=len(good)))
 
 
 if __name__ == "__main__":

@@ -31,6 +31,57 @@ def test_peak_flops_unknown_is_none():
     assert bench.peak_flops("Graphcore IPU") is None
 
 
+# ---- FLOPs resolution (the round-2 30x MFU bug, VERDICT r2 weak #1) ----
+# At b2048 the true per-step figure is ~5.97e12 (measured w1 on the real
+# chip); the buggy path divided the scan body's cost by the window again
+# and published 1.99e11. These tests mock the cost-analysis inputs.
+
+B2048_TRUE = bench.RESNET18_CIFAR_TRAIN_FLOPS_PER_IMAGE * 2048
+
+
+def test_resolve_prefers_w1_step_cost():
+    # When the loop-free step's cost is available it wins outright — the
+    # scanned program's ambiguous number must not even be consulted.
+    f, source, check = bench.resolve_flops_per_step(
+        program_flops=B2048_TRUE, step_flops=5.97e12, window=30,
+        per_chip_batch=2048)
+    assert f == 5.97e12 and source == "w1_step_cost_analysis" and check == "ok"
+
+
+def test_resolve_scan_body_only_semantics_not_divided():
+    # jaxlib reports the scan BODY once: dividing by window again is the
+    # round-2 bug. Body reading is log-closer to analytic => keep as-is.
+    f, source, check = bench.resolve_flops_per_step(
+        program_flops=5.97e12, step_flops=None, window=30, per_chip_batch=2048)
+    assert f == 5.97e12
+    assert source == "scan_cost_analysis_body" and check == "ok"
+
+
+def test_resolve_scan_multiplied_semantics_divided():
+    # A jaxlib that DOES multiply by trip count must be divided back down.
+    f, source, check = bench.resolve_flops_per_step(
+        program_flops=30 * 5.97e12, step_flops=None, window=30,
+        per_chip_batch=2048)
+    assert f == 5.97e12
+    assert source == "scan_cost_analysis_divided" and check == "ok"
+
+
+def test_resolve_analytic_fallback():
+    f, source, check = bench.resolve_flops_per_step(
+        program_flops=None, step_flops=None, window=30, per_chip_batch=1024)
+    assert f == bench.RESNET18_CIFAR_TRAIN_FLOPS_PER_IMAGE * 1024
+    assert source == "analytic" and check == "unverified"
+
+
+def test_resolve_flags_mismatch_with_analytic():
+    # A cost number 30x off analytic (the exact round-2 failure magnitude,
+    # had it come from the step path) must be flagged, never silent.
+    f, source, check = bench.resolve_flops_per_step(
+        program_flops=None, step_flops=5.97e12 / 30, window=1,
+        per_chip_batch=2048)
+    assert check.startswith("mismatch:")
+
+
 def _write_archive(tmp_path, records):
     p = tmp_path / "results.jsonl"
     p.write_text("".join(json.dumps(r) + "\n" for r in records))
@@ -68,6 +119,9 @@ def test_last_good_archived_best_of_latest_run(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "RESULTS_PATH", p)
     rec = bench.last_good_archived()
     assert rec is not None and rec["value"] == 31000.0
+    # A stale re-emission must say how many points back it up (1-point
+    # archive vs full sweep — VERDICT r2 next-round item 8).
+    assert rec["run_n_points"] == 2
 
 
 def test_last_good_archived_none_on_missing_or_junk(tmp_path, monkeypatch):
