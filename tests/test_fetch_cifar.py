@@ -1,0 +1,118 @@
+"""tools/fetch_cifar.py — everything testable without egress.
+
+The download itself needs the network this box doesn't have; what these
+tests pin down is the rest of the contract: the extracted layout is exactly
+what `tpu_dp.data.cifar.load_dataset` reads (end-to-end through the
+production reader), checksum failures are fatal and leave no partial file,
+extraction is allowlisted (a hostile archive can't escape the root), and
+the egress gate answers quickly instead of hanging.
+"""
+
+import hashlib
+import io
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+from tools import fetch_cifar
+
+
+def _fake_cifar10_tar(tmp_path, n_per_batch=4):
+    """A miniature cifar-10-python.tar.gz in the canonical layout."""
+    rng = np.random.default_rng(0)
+    batches = {}
+    for fname in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        data = rng.integers(0, 256, size=(n_per_batch, 3072), dtype=np.uint8)
+        labels = rng.integers(0, 10, size=n_per_batch).tolist()
+        batches[fname] = {b"data": data, b"labels": labels}
+    tar_path = tmp_path / "cifar-10-python.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for fname, payload in batches.items():
+            blob = pickle.dumps(payload)
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{fname}")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    return tar_path, batches
+
+
+def test_extract_then_production_reader_roundtrip(tmp_path):
+    tar_path, batches = _fake_cifar10_tar(tmp_path)
+    root = tmp_path / "data"
+    spec = fetch_cifar.SPECS["cifar10"]
+    out = fetch_cifar.extract(tar_path, root, spec["dirname"], spec["files"])
+    assert len(out) == 6
+
+    from tpu_dp.data.cifar import load_dataset
+
+    ds = load_dataset("cifar10", root, train=True, allow_synthetic=False)
+    assert not ds.synthetic and len(ds) == 20 and ds.num_classes == 10
+    # Pixel-exact CHW->NHWC: first example of data_batch_1.
+    flat = batches["data_batch_1"][b"data"][0]
+    np.testing.assert_array_equal(
+        ds.images[0], flat.reshape(3, 32, 32).transpose(1, 2, 0)
+    )
+    assert ds.labels[0] == batches["data_batch_1"][b"labels"][0]
+
+    test_ds = load_dataset("cifar10", root, train=False, allow_synthetic=False)
+    assert not test_ds.synthetic and len(test_ds) == 4
+
+
+def test_extract_missing_member_raises(tmp_path):
+    tar_path, _ = _fake_cifar10_tar(tmp_path)
+    with pytest.raises(RuntimeError, match="missing member"):
+        fetch_cifar.extract(tar_path, tmp_path / "data",
+                            "cifar-10-batches-py", ["data_batch_99"])
+
+
+def test_extract_ignores_traversal_members(tmp_path):
+    # A member named ../evil must be unreachable: extraction looks up only
+    # the allowlisted <dirname>/<fname> names.
+    tar_path = tmp_path / "hostile.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        blob = b"pwned"
+        info = tarfile.TarInfo("../evil")
+        info.size = len(blob)
+        tf.addfile(info, io.BytesIO(blob))
+        ok = pickle.dumps({b"data": np.zeros((1, 3072), np.uint8),
+                           b"labels": [0]})
+        info2 = tarfile.TarInfo("cifar-10-batches-py/data_batch_1")
+        info2.size = len(ok)
+        tf.addfile(info2, io.BytesIO(ok))
+    root = tmp_path / "data"
+    fetch_cifar.extract(tar_path, root, "cifar-10-batches-py",
+                        ["data_batch_1"])
+    assert (root / "cifar-10-batches-py" / "data_batch_1").exists()
+    assert not (tmp_path / "evil").exists() and not (root / "evil").exists()
+
+
+def test_download_verifies_md5_via_file_url(tmp_path):
+    src = tmp_path / "payload.bin"
+    src.write_bytes(b"cifar bytes")
+    url = src.as_uri()
+    good = hashlib.md5(b"cifar bytes").hexdigest()
+    dest = tmp_path / "out.bin"
+    fetch_cifar.download(url, dest, good)
+    assert dest.read_bytes() == b"cifar bytes"
+
+    bad_dest = tmp_path / "out2.bin"
+    with pytest.raises(RuntimeError, match="md5 mismatch"):
+        fetch_cifar.download(url, bad_dest, "0" * 32)
+    assert not bad_dest.exists()  # no truncated/poisoned file left behind
+
+
+def test_egress_probe_fails_fast_offline():
+    import time
+
+    t0 = time.monotonic()
+    # Port 9 (discard) on loopback: nothing listens, refusal is immediate;
+    # the probe must answer False quickly, never hang.
+    assert fetch_cifar.egress_available("127.0.0.1", 9, timeout_s=0.5) is False
+    assert time.monotonic() - t0 < 5
+
+
+def test_verify_layout_reports_missing(tmp_path, capsys):
+    assert fetch_cifar.verify_layout(tmp_path, "cifar10") is False
+    out = capsys.readouterr().out
+    assert "FAIL" in out
