@@ -56,12 +56,26 @@ SPECS = {
 
 def egress_available(host: str = HOST, port: int = 443,
                      timeout_s: float = 5.0) -> bool:
-    """True iff a TCP connection to the dataset host succeeds quickly."""
-    try:
+    """True iff a TCP connection to the dataset host succeeds quickly.
+
+    The whole probe — including DNS resolution, which
+    `socket.create_connection`'s timeout does NOT bound and which can
+    stall for minutes on a zero-egress box with black-holed resolvers —
+    runs in a worker thread joined with a hard deadline.
+    """
+    import concurrent.futures
+
+    def _probe() -> bool:
         with socket.create_connection((host, port), timeout=timeout_s):
             return True
-    except OSError:
+
+    ex = concurrent.futures.ThreadPoolExecutor(1)
+    try:
+        return ex.submit(_probe).result(timeout=2 * timeout_s)
+    except (OSError, concurrent.futures.TimeoutError):
         return False
+    finally:
+        ex.shutdown(wait=False)  # a DNS-stuck thread must not block exit
 
 
 def download(url: str, dest: Path, expect_md5: str,
@@ -176,7 +190,11 @@ def main() -> int:
         print(f"downloading {spec['url']} ...")
         try:
             download(spec["url"], tar_path, spec["md5"])
-        except (urllib.error.URLError, TimeoutError) as e:
+        except (urllib.error.URLError, TimeoutError, OSError,
+                RuntimeError) as e:
+            # URLError: unreachable/HTTP failure; OSError: mid-stream reset;
+            # RuntimeError: md5 mismatch. All are the same user story —
+            # clean exit-2 diagnosis, per the module contract.
             print(f"fetch_cifar: download failed: {e}", file=sys.stderr)
             return 2
         print(f"extracting {len(spec['files'])} batch files into "

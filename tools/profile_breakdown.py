@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Trace one scanned bench window on the device and print an op breakdown.
+
+Produces the numbers behind docs/DESIGN.md "Where the other half of peak
+goes": captures a `jax.profiler` trace of a `make_multi_step` window
+(identical config to bench.py's headline point), parses the xplane proto,
+and aggregates device time by HLO category plus a per-op efficiency table
+(achieved TFLOP/s and GB/s vs the chip's peaks).
+
+    python tools/profile_breakdown.py                  # b2048, w30 (headline)
+    python tools/profile_breakdown.py --per-chip-batch 1024 --window 30
+
+Parsing notes (this environment): the Perfetto trace.json.gz export carries
+host lanes only on this relay transport — the device lanes live in the
+xplane.pb, read here via tensorflow's bundled xplane proto. The protobuf
+runtime rejects that generated module under the C++ backend, so this tool
+re-execs itself with PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python when
+needed. Tracing inflates wall time (trace upload over the relay); the
+*within-trace* device timestamps remain accurate, which is what's reported.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+import tempfile
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+V5E_PEAK_TFLOPS = 197.0
+V5E_PEAK_HBM_GBS = 819.0
+
+
+def capture(trace_dir: str, per_chip: int, window: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dp.data.cifar import make_synthetic
+    from tpu_dp.models import ResNet18
+    from tpu_dp.parallel import dist
+    from tpu_dp.parallel.sharding import scan_batch_sharding, shard_batch
+    from tpu_dp.train import SGD, cosine_lr, create_train_state, make_multi_step
+
+    mesh = dist.data_mesh()
+    gb = per_chip * int(mesh.devices.size)
+    model = ResNet18(num_classes=10, dtype=jnp.bfloat16)
+    opt = SGD(momentum=0.9, weight_decay=5e-4)
+    state = create_train_state(model, jax.random.PRNGKey(0),
+                               np.zeros((1, 32, 32, 3), np.float32), opt)
+    pool_host = [make_synthetic(gb, 10, seed=i, name="bench") for i in range(4)]
+    stacked = {"image": np.stack([d.images for d in pool_host]),
+               "label": np.stack([d.labels for d in pool_host])}
+    pool = shard_batch(stacked, mesh, spec=scan_batch_sharding(mesh))
+    loop = make_multi_step(model, opt, mesh, cosine_lr(0.4, 2 * window, 2),
+                           num_steps=window)
+    state, m = loop(state, pool)  # compile + warmup
+    float(m["loss"][-1])
+    with jax.profiler.trace(trace_dir):
+        state, m = loop(state, pool)
+        float(m["loss"][-1])  # fence inside the trace
+
+
+def report(trace_dir: str, top: int) -> None:
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
+    if not paths:
+        sys.exit(f"no xplane.pb under {trace_dir}")
+    xs = xplane_pb2.XSpace()
+    xs.ParseFromString(open(sorted(paths)[-1], "rb").read())
+    devs = [p for p in xs.planes if p.name.startswith("/device:")
+            and any(line.events for line in p.lines)]
+    if not devs:
+        sys.exit("no device plane with events (tracing unsupported here?)")
+    dev = devs[0]
+    md, sm = dev.event_metadata, dev.stat_metadata
+    sname = {k: v.name for k, v in sm.items()}
+    op_lines = [line for line in dev.lines if line.name == "XLA Ops"]
+    if not op_lines:
+        sys.exit(f"device plane {dev.name} has no 'XLA Ops' line "
+                 f"(lines: {[line.name for line in dev.lines]})")
+    ops = op_lines[0]
+
+    by_cat = defaultdict(float)
+    per_op = defaultdict(lambda: [0.0, 0, 0, 0])  # dur_s, flops, bytes, n
+    window_s = 0.0
+    for e in ops.events:
+        m = md[e.metadata_id]
+        if m.name.startswith("%while"):  # scan wrapper spans the whole window
+            window_s += e.duration_ps / 1e12
+            continue
+        st = {sname[s.metadata_id]: s for s in m.stats}
+        cat = st["hlo_category"].str_value if "hlo_category" in st else "?"
+        by_cat[cat] += e.duration_ps / 1e12
+        fl = (st["model_flops"].int64_value if "model_flops" in st
+              else st["flops"].int64_value if "flops" in st else 0)
+        by = st["bytes_accessed"].int64_value if "bytes_accessed" in st else 0
+        rec = per_op[m.name.split(" = ")[0]]
+        rec[0] += e.duration_ps / 1e12
+        rec[1] += fl
+        rec[2] += by
+        rec[3] += 1
+
+    total = sum(by_cat.values())
+    if total <= 0:
+        sys.exit("no non-wrapper op events in the trace — was a step "
+                 "actually executed inside the profiled region?")
+    print(f"\ndevice {dev.name}: window {window_s*1e3:.1f} ms, "
+          f"op-busy {total*1e3:.1f} ms, idle {max(0, window_s-total)*1e3:.1f} ms")
+    print("\n-- by HLO category --")
+    for k, v in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+        print(f"{v*1e3:9.1f} ms {100*v/total:6.1f}%  {k}")
+
+    tot_f = sum(r[1] for r in per_op.values())
+    print(f"\nmodel FLOPs in window: {tot_f/1e12:.2f} T "
+          f"(avg {tot_f/total/1e12:.1f} TF/s, "
+          f"{100*tot_f/total/(V5E_PEAK_TFLOPS*1e12):.0f}% of v5e bf16 peak)")
+    print(f"\n-- top {top} ops by device time --")
+    print(f"{'ms':>8} {'TF/s':>6} {'%peak':>6} {'GB/s':>7} {'n':>4}  op")
+    for base, (d, f, b, n) in sorted(per_op.items(),
+                                     key=lambda kv: -kv[1][0])[:top]:
+        print(f"{d*1e3:8.1f} {f/d/1e12:6.1f} "
+              f"{100*f/d/(V5E_PEAK_TFLOPS*1e12):6.1f} {b/d/1e9:7.0f} "
+              f"{n:4d}  {base}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--per-chip-batch", type=int, default=2048)
+    ap.add_argument("--window", type=int, default=30)
+    ap.add_argument("--trace-dir", default=None,
+                    help="reuse/keep a trace dir (default: temp, capture+report)")
+    ap.add_argument("--report-only", action="store_true",
+                    help="parse an existing --trace-dir without touching the device")
+    ap.add_argument("--top", type=int, default=25)
+    args = ap.parse_args()
+
+    # The TF-bundled xplane_pb2 needs the pure-python protobuf runtime.
+    if os.environ.get("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION") != "python":
+        os.environ["PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION"] = "python"
+        os.execv(sys.executable, [sys.executable] + sys.argv)
+
+    trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="tpu_dp_trace_")
+    if not args.report_only:
+        capture(trace_dir, args.per_chip_batch, args.window)
+    report(trace_dir, args.top)
+    print(f"\ntrace kept at {trace_dir}")
+
+
+if __name__ == "__main__":
+    main()
