@@ -62,16 +62,33 @@ METRIC = "cifar10_resnet18_train_images_per_sec_per_chip"
 UNIT = "images/sec/chip"
 RESULTS_PATH = Path(__file__).resolve().parent / "benchmarks" / "results.jsonl"
 
-# Analytic conv+dot FLOPs for one *trained* image, CIFAR ResNet-18
-# (`tpu_dp/models/resnet.py`: 3x3 stem, stages [2,2,2,2] at widths
-# 64/128/256/512 on feature maps 32/16/8/4). Forward MACs: stem 1.77M +
+# Analytic conv+dot FLOPs for one *trained* image (used to disambiguate
+# cost_analysis() loop semantics and to sanity-check the published MFU).
+# CIFAR ResNet-18 (`tpu_dp/models/resnet.py`: 3x3 stem, stages [2,2,2,2]
+# at widths 64/128/256/512 on feature maps 32/16/8/4): stem 1.77M +
 # stage1 151.0M + stages2-4 134.2M each + fc 5.1K = 555.4M MACs
 # = 1.11 GFLOP forward; training ~= 3x forward (grad wrt weights + wrt
 # activations) = ~3.3 GFLOP, minus the stem's unneeded input-grad and
-# whatever XLA folds away => ~2.9-3.3e9. Used to disambiguate
-# cost_analysis() loop semantics and to sanity-check the published MFU.
+# whatever XLA folds away => ~2.9-3.3e9 (XLA's compiled count measures
+# 0.875x the 3x-forward figure). CIFAR ResNet-50 (bottleneck, [3,4,6,3]):
+# 1297.8M MACs forward by the same per-layer count => 7.79 GFLOP trained,
+# x0.875 => ~7.0e9.
 RESNET18_CIFAR_TRAIN_FLOPS_PER_IMAGE = 3.0e9
+# (model name -> (analytic trained FLOPs/image, default num_classes))
+MODEL_SPECS = {
+    "resnet18": (RESNET18_CIFAR_TRAIN_FLOPS_PER_IMAGE, 10),
+    "resnet50": (7.0e9, 100),  # BASELINE.json config 3: ResNet-50/CIFAR-100
+}
 FLOPS_CHECK_RTOL = 1.35  # +-35%: covers bwd-pass accounting slop, not 30x
+
+
+def metric_for(model: str, num_classes: int) -> str:
+    return f"cifar{num_classes}_{model}_train_images_per_sec_per_chip"
+
+
+def headline_metric(model: str) -> str:
+    """The metric name a given model's headline records under."""
+    return metric_for(model, MODEL_SPECS[model][1])
 
 # bf16 peak matmul FLOP/s per chip, by device_kind substring (first match
 # wins; ordered so "v5 lite" is tested before "v5"). Public spec-sheet
@@ -98,7 +115,8 @@ def peak_flops(device_kind: str) -> float | None:
     return None
 
 
-def resolve_flops_per_step(program_flops, step_flops, window, per_chip_batch):
+def resolve_flops_per_step(program_flops, step_flops, window, per_chip_batch,
+                           flops_per_image):
     """Per-optimizer-step per-chip FLOPs for MFU; robust to scan cost semantics.
 
     All inputs and the result are PER-DEVICE: `compiled.cost_analysis()`
@@ -126,7 +144,7 @@ def resolve_flops_per_step(program_flops, step_flops, window, per_chip_batch):
     else "mismatch:analytic_ratio=R" — published in the record so a wrong
     MFU can never again look routine.
     """
-    analytic = RESNET18_CIFAR_TRAIN_FLOPS_PER_IMAGE * per_chip_batch
+    analytic = flops_per_image * per_chip_batch
     if step_flops:
         resolved, source = float(step_flops), "w1_step_cost_analysis"
     elif program_flops:
@@ -232,7 +250,7 @@ def measure_point(cfg: dict) -> dict:
     import numpy as np
 
     from tpu_dp.data.cifar import make_synthetic
-    from tpu_dp.models import ResNet18
+    from tpu_dp.models import build_model
     from tpu_dp.parallel import dist
     from tpu_dp.parallel.sharding import (
         batch_sharding, scan_batch_sharding, shard_batch,
@@ -245,12 +263,16 @@ def measure_point(cfg: dict) -> dict:
     window = int(cfg["steps_per_call"])
     measure_steps = int(cfg["measure_steps"])
     use_pallas = bool(cfg["pallas_xent"])
+    model_name = cfg.get("model", "resnet18")
+    flops_per_image, num_classes = MODEL_SPECS[model_name]
+    metric = metric_for(model_name, num_classes)
 
     mesh = dist.data_mesh()
     n_chips = int(mesh.devices.size)
     global_batch = per_chip * n_chips
 
-    model = ResNet18(num_classes=10, dtype=jnp.bfloat16)
+    model = build_model(model_name, num_classes=num_classes,
+                        dtype=jnp.bfloat16)
     opt = SGD(momentum=0.9, weight_decay=5e-4)
     state = create_train_state(
         model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32), opt
@@ -261,7 +283,7 @@ def measure_point(cfg: dict) -> dict:
 
     # 4-slot pool of device-resident uint8 batches (normalize fuses into the
     # step on device, matching the production pipeline's host->HBM format).
-    host_pool = [make_synthetic(global_batch, 10, seed=i, name="bench")
+    host_pool = [make_synthetic(global_batch, num_classes, seed=i, name="bench")
                  for i in range(4)]
 
     def compile_with_flops(jitted, *eg_args):
@@ -330,11 +352,14 @@ def measure_point(cfg: dict) -> dict:
             # cost_analysis reports the per-device SPMD module's FLOPs.
             mfu = round(flops_per_step * n_steps_timed / elapsed / peak, 4)
         return {
-            "metric": METRIC,
+            "metric": metric,
             "value": round(per_chip_ips, 1),
             "unit": UNIT,
-            "vs_baseline": round(
-                per_chip_ips / V100_BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+            # The 2,500 img/s/V100 bar is a ResNet-18 figure; comparing a
+            # ResNet-50 run against it would overstate the baseline.
+            "vs_baseline": (
+                round(per_chip_ips / V100_BASELINE_IMG_PER_SEC_PER_CHIP, 3)
+                if model_name == "resnet18" else None),
             "mfu": mfu,
             "ms_per_step": round(elapsed / n_steps_timed * 1e3, 3),
             "flops_per_step_per_chip": flops_per_step,
@@ -344,7 +369,7 @@ def measure_point(cfg: dict) -> dict:
             "device_kind": device_kind,
             "n_chips": n_chips,
             "config": {
-                "model": "resnet18", "dtype": "bfloat16",
+                "model": model_name, "dtype": "bfloat16",
                 "per_chip_batch": per_chip, "steps_per_call": window,
                 "measured_steps": n_steps_timed,
                 "xent": "pallas" if use_pallas else "jnp",
@@ -360,7 +385,7 @@ def measure_point(cfg: dict) -> dict:
         # even if the relay wedges in the extra compile and the parent has
         # to kill this child; a clean finish overprints it below.
         emit(build(*resolve_flops_per_step(
-            program_flops, None, window, per_chip)))
+            program_flops, None, window, per_chip, flops_per_image)))
         try:
             step = make_train_step(model, opt, mesh, sched,
                                    use_pallas_xent=use_pallas)
@@ -373,7 +398,7 @@ def measure_point(cfg: dict) -> dict:
                   f"keeping scan/analytic FLOPs reading", file=sys.stderr)
 
     return build(*resolve_flops_per_step(
-        program_flops, step_flops, window, per_chip))
+        program_flops, step_flops, window, per_chip, flops_per_image))
 
 
 # --------------------------------------------------------------------------
@@ -386,13 +411,15 @@ def archive(record: dict) -> None:
         f.write(json.dumps(record) + "\n")
 
 
-def last_good_archived() -> dict | None:
-    """Best accelerator measurement from the most recent archived run.
+def last_good_archived(metric: str = METRIC) -> dict | None:
+    """Best accelerator measurement of ``metric`` from its most recent run.
 
     A run (one bench invocation; shared "ts") may be a 12-point sweep whose
     last-written point is a deliberately-slow comparison config (window=1,
     dispatch-bound) — the stale fallback must mirror the live headline
     semantics (best point of the run), not whichever line landed last.
+    The metric filter keeps e.g. an archived ResNet-50 point from being
+    re-emitted as the ResNet-18 headline.
     """
     try:
         lines = RESULTS_PATH.read_text().splitlines()
@@ -404,7 +431,11 @@ def last_good_archived() -> dict | None:
             rec = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if rec.get("value") and rec.get("backend") not in (None, "cpu"):
+        # Metric-less lines predate multi-model support and were all
+        # implicitly the resnet18 headline — default them to METRIC so a
+        # resnet50 query can never pick one up.
+        if (rec.get("value") and rec.get("backend") not in (None, "cpu")
+                and rec.get("metric", METRIC) == metric):
             good.append(rec)
     if not good:
         return None
@@ -430,7 +461,8 @@ def run_point(cfg: dict, timeout_s: float) -> dict:
     tail = (err.strip().splitlines() or ["no stderr"])[-1]
     cause = (f"measurement timeout after {timeout_s:.0f}s" if rc == 124
              else f"measurement rc={rc}: {tail[:300]}")
-    return {"metric": METRIC, "value": None, "unit": UNIT,
+    return {"metric": headline_metric(cfg.get("model", "resnet18")),
+            "value": None, "unit": UNIT,
             "vs_baseline": None, "error": cause, "config": cfg}
 
 
@@ -446,6 +478,10 @@ def main() -> None:
                          "single headline point")
     ap.add_argument("--platform", default=None, choices=["cpu"],
                     help="force the cpu backend (harness smoke test)")
+    ap.add_argument("--model", default="resnet18", choices=sorted(MODEL_SPECS),
+                    help="resnet18 = the north-star metric; resnet50 = "
+                         "BASELINE config 3 (100-way head), archived under "
+                         "its own metric name")
     ap.add_argument("--per-chip-batch", type=int, default=2048)
     ap.add_argument("--measure-steps", type=int, default=30,
                     help="timed optimizer steps on the per-step (window=1) "
@@ -467,12 +503,14 @@ def main() -> None:
     if args.platform == "cpu":
         env = dict(os.environ, JAX_PLATFORMS="cpu")
 
+    hmetric = headline_metric(args.model)
     info, failure = probe_device(args.probe_attempts, args.probe_timeout,
                                  args.probe_retry_wait, env=env)
     if info is None:
-        stale = last_good_archived()
+        stale = last_good_archived(hmetric)
         if stale is not None:
-            emit({"metric": stale["metric"], "value": stale["value"],
+            emit({"metric": stale.get("metric", METRIC),  # legacy lines lack it
+                  "value": stale["value"],
                   "unit": stale["unit"], "vs_baseline": stale["vs_baseline"],
                   "mfu": stale.get("mfu"), "stale": True,
                   "flops_source": stale.get("flops_source"),
@@ -483,7 +521,7 @@ def main() -> None:
                                   f"{stale.get('ts', 'unknown time')}",
                   "config": stale.get("config")})
         else:
-            emit({"metric": METRIC, "value": None, "unit": UNIT,
+            emit({"metric": hmetric, "value": None, "unit": UNIT,
                   "vs_baseline": None,
                   "error": f"device unavailable: {failure}; no archived "
                            f"result in {RESULTS_PATH}"})
@@ -491,7 +529,8 @@ def main() -> None:
     print(f"bench: device ok — {info['n_devices']}x {info['device_kind']} "
           f"({info['backend']})", file=sys.stderr)
 
-    base = {"measure_steps": args.measure_steps, "platform": args.platform}
+    base = {"measure_steps": args.measure_steps, "platform": args.platform,
+            "model": args.model}
     if args.sweep:
         grid = [
             dict(base, per_chip_batch=b, pallas_xent=px, steps_per_call=w)
@@ -519,7 +558,7 @@ def main() -> None:
 
     good = [r for r in results if r.get("value")]
     if not good:
-        emit({"metric": METRIC, "value": None, "unit": UNIT,
+        emit({"metric": hmetric, "value": None, "unit": UNIT,
               "vs_baseline": None,
               "error": results[0].get("error", "all points failed")})
         sys.exit(0)

@@ -44,7 +44,8 @@ def test_resolve_prefers_w1_step_cost():
     # scanned program's ambiguous number must not even be consulted.
     f, source, check = bench.resolve_flops_per_step(
         program_flops=B2048_TRUE, step_flops=5.97e12, window=30,
-        per_chip_batch=2048)
+        per_chip_batch=2048,
+        flops_per_image=bench.RESNET18_CIFAR_TRAIN_FLOPS_PER_IMAGE)
     assert f == 5.97e12 and source == "w1_step_cost_analysis" and check == "ok"
 
 
@@ -52,7 +53,8 @@ def test_resolve_scan_body_only_semantics_not_divided():
     # jaxlib reports the scan BODY once: dividing by window again is the
     # round-2 bug. Body reading is log-closer to analytic => keep as-is.
     f, source, check = bench.resolve_flops_per_step(
-        program_flops=5.97e12, step_flops=None, window=30, per_chip_batch=2048)
+        program_flops=5.97e12, step_flops=None, window=30, per_chip_batch=2048,
+        flops_per_image=bench.RESNET18_CIFAR_TRAIN_FLOPS_PER_IMAGE)
     assert f == 5.97e12
     assert source == "scan_cost_analysis_body" and check == "ok"
 
@@ -61,14 +63,16 @@ def test_resolve_scan_multiplied_semantics_divided():
     # A jaxlib that DOES multiply by trip count must be divided back down.
     f, source, check = bench.resolve_flops_per_step(
         program_flops=30 * 5.97e12, step_flops=None, window=30,
-        per_chip_batch=2048)
+        per_chip_batch=2048,
+        flops_per_image=bench.RESNET18_CIFAR_TRAIN_FLOPS_PER_IMAGE)
     assert f == 5.97e12
     assert source == "scan_cost_analysis_divided" and check == "ok"
 
 
 def test_resolve_analytic_fallback():
     f, source, check = bench.resolve_flops_per_step(
-        program_flops=None, step_flops=None, window=30, per_chip_batch=1024)
+        program_flops=None, step_flops=None, window=30, per_chip_batch=1024,
+        flops_per_image=bench.RESNET18_CIFAR_TRAIN_FLOPS_PER_IMAGE)
     assert f == bench.RESNET18_CIFAR_TRAIN_FLOPS_PER_IMAGE * 1024
     assert source == "analytic" and check == "unverified"
 
@@ -78,7 +82,8 @@ def test_resolve_flags_mismatch_with_analytic():
     # had it come from the step path) must be flagged, never silent.
     f, source, check = bench.resolve_flops_per_step(
         program_flops=None, step_flops=5.97e12 / 30, window=1,
-        per_chip_batch=2048)
+        per_chip_batch=2048,
+        flops_per_image=bench.RESNET18_CIFAR_TRAIN_FLOPS_PER_IMAGE)
     assert check.startswith("mismatch:")
 
 
@@ -122,6 +127,46 @@ def test_last_good_archived_best_of_latest_run(tmp_path, monkeypatch):
     # A stale re-emission must say how many points back it up (1-point
     # archive vs full sweep — VERDICT r2 next-round item 8).
     assert rec["run_n_points"] == 2
+
+
+def test_metric_for_models():
+    assert bench.metric_for("resnet18", 10) == bench.METRIC
+    assert (bench.metric_for("resnet50", 100)
+            == "cifar100_resnet50_train_images_per_sec_per_chip")
+    # Each supported model carries a plausible analytic count (R50 does
+    # ~2.3x the conv FLOPs of R18 on CIFAR shapes).
+    r18, r50 = bench.MODEL_SPECS["resnet18"][0], bench.MODEL_SPECS["resnet50"][0]
+    assert 2.0 < r50 / r18 < 2.7
+
+
+def test_last_good_archived_filters_by_metric(tmp_path, monkeypatch):
+    # An archived ResNet-50 point (its own metric) must never be re-emitted
+    # as the ResNet-18 headline, even when it is newer and faster-looking.
+    r50_metric = bench.metric_for("resnet50", 100)
+    p = _write_archive(tmp_path, [
+        {"metric": bench.METRIC, "value": 31000.0, "unit": bench.UNIT,
+         "vs_baseline": 12.4, "backend": "tpu", "ts": "2026-01-01T00:00:00Z"},
+        {"metric": r50_metric, "value": 99000.0, "unit": bench.UNIT,
+         "vs_baseline": None, "backend": "tpu", "ts": "2026-02-01T00:00:00Z"},
+    ])
+    monkeypatch.setattr(bench, "RESULTS_PATH", p)
+    rec = bench.last_good_archived()
+    assert rec is not None and rec["value"] == 31000.0
+    rec50 = bench.last_good_archived(r50_metric)
+    assert rec50 is not None and rec50["value"] == 99000.0
+
+
+def test_last_good_archived_metricless_lines_are_resnet18_only(tmp_path,
+                                                               monkeypatch):
+    # Pre-multi-model archive lines have no "metric" key and were all
+    # implicitly the resnet18 headline: a resnet50 query must skip them.
+    p = _write_archive(tmp_path, [
+        {"value": 30000.0, "unit": bench.UNIT, "vs_baseline": 12.0,
+         "backend": "tpu", "ts": "t1"},
+    ])
+    monkeypatch.setattr(bench, "RESULTS_PATH", p)
+    assert bench.last_good_archived()["value"] == 30000.0
+    assert bench.last_good_archived(bench.headline_metric("resnet50")) is None
 
 
 def test_last_good_archived_none_on_missing_or_junk(tmp_path, monkeypatch):
