@@ -1,0 +1,121 @@
+"""tools/profile_breakdown.py — xplane parsing, no device required.
+
+Builds a synthetic XSpace proto (one device plane, one 'XLA Ops' line, a
+%while wrapper spanning two real ops with hlo_category/model_flops/
+bytes_accessed stats) and checks the report: wrapper excluded from the
+category totals, categories aggregated, per-op TFLOP/s computed, and all
+four diagnostic exits (no xplane file, eventless device plane, missing
+'XLA Ops' line, wrapper-only trace).
+"""
+
+import pytest
+
+try:
+    # Needs the pure-python protobuf runtime (the C++ backend rejects the
+    # TF-generated module with TypeError, not ImportError) — the tool
+    # re-execs itself with this env var; tests must skip without it.
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2 as tf_xplane
+except Exception as e:  # noqa: BLE001 - any import failure means skip
+    pytest.skip(f"TF xplane proto unavailable ({type(e).__name__})",
+                allow_module_level=True)
+
+from tools import profile_breakdown  # noqa: E402
+
+
+def _stat_md(plane, sid, name):
+    plane.stat_metadata[sid].id = sid
+    plane.stat_metadata[sid].name = name
+    return sid
+
+
+def _build_xspace(tmp_path, wrapper_only=False, line_name="XLA Ops"):
+    xs = tf_xplane.XSpace()
+    plane = xs.planes.add()
+    plane.name = "/device:TPU:0"
+    s_cat = _stat_md(plane, 1, "hlo_category")
+    s_flops = _stat_md(plane, 2, "model_flops")
+    s_bytes = _stat_md(plane, 3, "bytes_accessed")
+
+    def event_md(eid, name, cat=None, flops=0, nbytes=0):
+        md = plane.event_metadata[eid]
+        md.id = eid
+        md.name = name
+        if cat is not None:
+            st = md.stats.add()
+            st.metadata_id = s_cat
+            st.str_value = cat
+        for sid, val in ((s_flops, flops), (s_bytes, nbytes)):
+            if val:
+                st = md.stats.add()
+                st.metadata_id = sid
+                st.int64_value = val
+        return eid
+
+    line = plane.lines.add()
+    line.name = line_name
+    # scan wrapper: 10 ms spanning everything — must not count as work
+    event_md(10, "%while.1 = ...")
+    e = line.events.add()
+    e.metadata_id = 10
+    e.offset_ps = 0
+    e.duration_ps = int(10e9)
+    if not wrapper_only:
+        # a conv: 6 ms, 1.2e9 FLOPs
+        event_md(11, "%convert_reduce_fusion.1 = ...",
+                 cat="convolution fusion", flops=int(1.2e9), nbytes=int(3e6))
+        e = line.events.add()
+        e.metadata_id = 11
+        e.offset_ps = 0
+        e.duration_ps = int(6e9)
+        # an elementwise fusion: 4 ms
+        event_md(12, "%fusion.9 = ...", cat="loop fusion", flops=0,
+                 nbytes=int(8e6))
+        e = line.events.add()
+        e.metadata_id = 12
+        e.offset_ps = int(6e9)
+        e.duration_ps = int(4e9)
+    p = tmp_path / "t.xplane.pb"
+    p.write_bytes(xs.SerializeToString())
+    return p
+
+
+def test_report_aggregates_and_excludes_wrapper(tmp_path, capsys):
+    _build_xspace(tmp_path)
+    profile_breakdown.report(str(tmp_path), top=5)
+    out = capsys.readouterr().out
+    # Window = the while span; busy = the two real ops; idle = 0.
+    assert "window 10.0 ms" in out and "op-busy 10.0 ms" in out
+    assert "convolution fusion" in out and "loop fusion" in out
+    # 60/40 split between the categories.
+    assert " 60.0%" in out and " 40.0%" in out
+    # Per-op rate: 1.2e9 FLOPs / 6 ms = 0.2 TF/s.
+    assert "%convert_reduce_fusion.1" in out
+    # The wrapper never appears as an op row.
+    assert "%while.1" not in out
+
+
+def test_report_exits_on_empty_dir(tmp_path):
+    with pytest.raises(SystemExit, match="no xplane.pb"):
+        profile_breakdown.report(str(tmp_path), top=5)
+
+
+def test_report_exits_when_only_wrapper_events(tmp_path):
+    _build_xspace(tmp_path, wrapper_only=True)
+    with pytest.raises(SystemExit, match="no non-wrapper op events"):
+        profile_breakdown.report(str(tmp_path), top=5)
+
+
+def test_report_exits_when_no_xla_ops_line(tmp_path):
+    _build_xspace(tmp_path, line_name="Steps")  # events, but no 'XLA Ops'
+    with pytest.raises(SystemExit, match="no 'XLA Ops' line"):
+        profile_breakdown.report(str(tmp_path), top=5)
+
+
+def test_report_exits_when_device_plane_has_no_events(tmp_path):
+    xs = tf_xplane.XSpace()
+    plane = xs.planes.add()
+    plane.name = "/device:TPU:0"
+    plane.lines.add().name = "XLA Ops"  # line exists, zero events
+    (tmp_path / "t.xplane.pb").write_bytes(xs.SerializeToString())
+    with pytest.raises(SystemExit, match="no device plane with events"):
+        profile_breakdown.report(str(tmp_path), top=5)
