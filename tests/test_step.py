@@ -64,6 +64,30 @@ def test_dp_equivalence_8_vs_1(setup, mesh8, mesh1):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_compiled_dp_step_contains_gradient_allreduce(setup, mesh8, mesh1):
+    """The DDP guarantee must exist as a real collective in the compiled
+    program, not merely as numerical equivalence: the GSPMD partitioner
+    must have inserted an all-reduce (the NCCL-allreduce analogue the
+    reference gets from DDP's reducer, `cifar_example_ddp.py:83`,
+    SURVEY.md §2B) into the 8-device program — and the 1-device program
+    must contain none (nothing to reduce across)."""
+    model, opt, state = setup
+    batch = _make_batch(0, 16)
+    # (.lower only traces avals — no execution, no donation, no copy needed)
+    hlo8 = (make_train_step(model, opt, mesh8, constant_lr(0.05))
+            .lower(state, batch).compile().as_text())
+    # Specifically the GRADIENT all-reduce, not just any collective (the
+    # sharded-batch metric means also lower to all-reduces): XLA emits the
+    # grads as a bucketed tuple all-reduce whose operands are param-shaped —
+    # conv1's kernel grad f32[5,5,3,6] must sit on an all-reduce line.
+    grad_ar = [l for l in hlo8.splitlines()
+               if "all-reduce(" in l and "f32[5,5,3,6]" in l]
+    assert grad_ar, "no param-shaped (gradient) all-reduce in 8-device HLO"
+    hlo1 = (make_train_step(model, opt, mesh1, constant_lr(0.05))
+            .lower(state, batch).compile().as_text())
+    assert "all-reduce" not in hlo1
+
+
 def test_multi_step_trajectory_equivalence(setup, mesh8, mesh1):
     """Replicas stay in lockstep over several steps (momentum included)."""
     model, opt, state = setup
