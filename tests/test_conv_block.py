@@ -1,0 +1,166 @@
+"""Tests for the fused affine+ReLU+3x3-conv Pallas kernel (ops/conv_block).
+
+Run in Pallas interpret mode on CPU (tests/conftest.py forces the cpu
+backend), so the exact kernel code the TPU runs is exercised here. The
+oracle is the unfused XLA statement of the same math
+(`reference_affine_relu_conv`), itself pinned against
+`lax.conv_general_dilated` — the op the reference's cuDNN convs
+(`/root/reference/cifar_example.py:20-25`) map to on TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dp.ops.conv_block import (
+    fused_affine_relu_conv,
+    reference_affine_relu_conv,
+)
+
+
+def _inputs(b=4, h=8, w=8, c=64, seed=0, dtype=jnp.bfloat16):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+    x = jax.random.normal(ks[0], (b, h, w, c), dtype)
+    wt = (jax.random.normal(ks[1], (3, 3, c, c)) * 0.1).astype(jnp.float32)
+    scale = jax.random.normal(ks[2], (c,)) * 0.5 + 1.0
+    shift = jax.random.normal(ks[3], (c,)) * 0.1
+    res = jax.random.normal(ks[4], (b, h, w, c), dtype)
+    return x, wt, scale, shift, res
+
+
+@pytest.mark.parametrize("with_res", [False, True])
+def test_forward_matches_xla(with_res):
+    x, wt, scale, shift, res = _inputs()
+    r = res if with_res else None
+    y = fused_affine_relu_conv(x, wt, scale, shift, r, 2)
+    yr = reference_affine_relu_conv(x, wt, scale, shift, r)
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(yr, np.float32),
+        rtol=0, atol=2e-5,
+    )
+
+
+def test_batch_not_divisible_by_block():
+    # 5 images with block_b=2: the pad row must not leak into outputs.
+    x, wt, scale, shift, _ = _inputs(b=5)
+    y = fused_affine_relu_conv(x, wt, scale, shift, None, 2)
+    yr = reference_affine_relu_conv(x, wt, scale, shift, None)
+    assert y.shape == yr.shape == (5, 8, 8, 64)
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(yr, np.float32),
+        rtol=0, atol=2e-5,
+    )
+
+
+def test_same_padding_edges():
+    # A constant-1 input makes border outputs differ from interior ones
+    # exactly by the zero-padding contribution — a direct probe that the
+    # kernel's row-shift trick reproduces SAME-conv edge semantics.
+    c = 64
+    x = jnp.ones((2, 8, 8, c), jnp.float32)
+    wt = jnp.ones((3, 3, c, c), jnp.float32) * 0.01
+    scale = jnp.ones((c,))
+    shift = jnp.zeros((c,))
+    y = fused_affine_relu_conv(x, wt, scale, shift, None, 2)
+    yr = reference_affine_relu_conv(x, wt, scale, shift, None)
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(yr, np.float32),
+        rtol=1e-6, atol=1e-4,
+    )
+    # Interior = 9 taps, edge = 6, corner = 4 (rel 1e-2: bf16 rounding).
+    got = np.asarray(y, np.float32)[0, :, :, 0]
+    assert got[4, 4] == pytest.approx(9 * 0.64, rel=1e-2)
+    assert got[0, 4] == pytest.approx(6 * 0.64, rel=1e-2)
+    assert got[0, 0] == pytest.approx(4 * 0.64, rel=1e-2)
+
+
+@pytest.mark.parametrize("with_res", [False, True])
+def test_grads_match_xla(with_res):
+    x, wt, scale, shift, res = _inputs(b=2)
+    r = res if with_res else None
+    argnums = (0, 1, 2, 3, 4) if with_res else (0, 1, 2, 3)
+
+    def loss_fused(x, wt, s, b, r=None):
+        return jnp.sum(
+            fused_affine_relu_conv(x, wt, s, b, r, 2).astype(jnp.float32) ** 2)
+
+    def loss_ref(x, wt, s, b, r=None):
+        return jnp.sum(
+            reference_affine_relu_conv(x, wt, s, b, r).astype(jnp.float32) ** 2)
+
+    args = (x, wt, scale, shift) + ((res,) if with_res else ())
+    gf = jax.grad(loss_fused, argnums=argnums)(*args)
+    gr = jax.grad(loss_ref, argnums=argnums)(*args)
+    for name, a, b_ in zip("x w scale shift res".split(), gf, gr):
+        a = np.asarray(a, np.float32)
+        b_ = np.asarray(b_, np.float32)
+        scale_ref = np.max(np.abs(b_)) + 1e-6
+        np.testing.assert_allclose(
+            a / scale_ref, b_ / scale_ref, rtol=0, atol=1e-5,
+            err_msg=f"grad mismatch for {name}")
+
+
+def test_jit_and_dtype_preserved():
+    x, wt, scale, shift, _ = _inputs()
+    y = jax.jit(lambda *a: fused_affine_relu_conv(*a, None, 2))(
+        x, wt, scale, shift)
+    assert y.dtype == x.dtype
+    assert y.shape == x.shape
+
+
+def test_batch_sharding_propagates_under_mesh(mesh8):
+    # Without the op's custom partitioning rule, GSPMD treats the
+    # pallas_call as an opaque op and replicates it — the output sharding
+    # here is the regression probe (it was PartitionSpec() before the rule).
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x, wt, scale, shift, res = _inputs(b=16)
+    xs = jax.device_put(x, NamedSharding(mesh8, P("data")))
+    rs = jax.device_put(res, NamedSharding(mesh8, P("data")))
+    ws = jax.device_put(wt, NamedSharding(mesh8, P()))
+
+    f = jax.jit(lambda x, w, r: fused_affine_relu_conv(x, w, scale, shift,
+                                                       r, 2))
+    y = f(xs, ws, rs)
+    assert y.sharding.spec == P("data")
+    yr = reference_affine_relu_conv(x, wt, scale, shift, res)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(yr, np.float32), atol=2e-2)
+
+    g = jax.jit(jax.grad(lambda x, w, r: jnp.sum(
+        fused_affine_relu_conv(x, w, scale, shift, r, 2)
+        .astype(jnp.float32) ** 2), argnums=(0, 1)))
+    gx, gw = g(xs, ws, rs)
+    assert gx.sharding.spec == P("data")
+    grx, grw = jax.grad(lambda x, w: jnp.sum(
+        reference_affine_relu_conv(x, w, scale, shift, res)
+        .astype(jnp.float32) ** 2), argnums=(0, 1))(x, wt)
+    for a, b in ((gx, grx), (gw, grw)):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        m = np.abs(b).max() + 1e-6
+        np.testing.assert_allclose(a / m, b / m, atol=1e-2)
+
+
+def test_activate_false_is_plain_affine_conv():
+    x, wt, scale, shift, _ = _inputs()
+    y = fused_affine_relu_conv(x, wt, scale, shift, None, 2, False)
+    yr = reference_affine_relu_conv(x, wt, scale, shift, None, activate=False)
+    # atol = one bf16 ulp at this magnitude: accumulation order differs
+    # between the kernel's single f32 accumulator and lax.conv's reduction.
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(yr, np.float32),
+        rtol=0, atol=4e-2,
+    )
+    # With ReLU on, a negative-heavy input must differ.
+    y_act = fused_affine_relu_conv(x, wt, scale, shift, None, 2, True)
+    assert np.abs(np.asarray(y_act, np.float32)
+                  - np.asarray(y, np.float32)).max() > 0.1
+
+
+def test_rejects_non_3x3():
+    x, _, scale, shift, _ = _inputs()
+    bad = jnp.zeros((1, 1, 64, 64), jnp.float32)
+    with pytest.raises(ValueError, match="3x3"):
+        fused_affine_relu_conv(x, bad, scale, shift, None, 2)

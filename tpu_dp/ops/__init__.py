@@ -10,5 +10,12 @@ ring allreduce fallback for host coordination off-TPU — are native C++
 """
 
 from tpu_dp.ops import native
+from tpu_dp.ops.conv_block import fused_affine_relu_conv
+from tpu_dp.ops.xent import mean_softmax_xent, softmax_xent
 
-__all__ = ["native"]
+__all__ = [
+    "native",
+    "fused_affine_relu_conv",
+    "mean_softmax_xent",
+    "softmax_xent",
+]

@@ -1,0 +1,291 @@
+"""Fused affine+ReLU+3x3-conv Pallas TPU kernel for ResNet stage-1 shapes.
+
+Why this kernel exists (profiled, docs/DESIGN.md "Where the other half of
+peak goes"): the bench's step time is wall-to-wall convolutions, and the
+early 64-channel stage is the inefficient part — XLA runs the stage-1
+3x3 convs at 18-45% of bf16 peak, streaming [B,32,32,64] activations
+from HBM, with the BatchNorm-normalize/ReLU chains between convs compiled
+as *separate* loop fusions that cost an extra HBM round trip per tensor
+(6.9% of device time on their own). The reference hits the same structure
+via cuDNN (`/root/reference/cifar_example_ddp.py:104` lowers to
+implicit-gemm kernels); this is the TPU answer, not a translation of it.
+
+The kernel fuses, per batch tile, entirely in VMEM:
+
+    z = relu(x * scale + shift [+ residual])     # the BN-apply epilogue
+    y = conv3x3_SAME(z, W)                       # stride 1, C_in=C_out=C
+
+so the normalized activation `z` never exists in HBM — and the conv is a
+single MXU contraction per tile ("one-matmul conv"): rows = (b, h, w')
+over the padded width, K = (dh, c_in) from three H-shifted input slices,
+N = (dw, c_out) packing all three column taps as output blocks, which a
+row shift then realigns. For C=64 that is a [rows,192]x[192,192] matmul —
+far better MXU occupancy than the K=64, N=64 dots XLA's conv emitter can
+use at this channel width.
+
+`scale`/`shift` are per-channel f32 vectors; callers fold whatever affine
+they need into them (for BatchNorm: scale = gamma/sqrt(var+eps),
+shift = beta - mean*scale). `residual` is the pre-activation skip branch
+(added before the ReLU), so one invocation consumes the tail of the
+previous block (BN-apply + residual-add + ReLU) and produces the next
+conv — a whole stage chains through VMEM. `activate=False` skips the
+ReLU for use as a plain (affine-)conv.
+
+Distribution: the op carries a `jax.experimental.custom_partitioning`
+rule that shards the batch dimension over the mesh and runs the kernel
+on each device's local shard — without it, GSPMD treats the pallas_call
+as an opaque replicated op and serializes the hot path (verified on the
+8-virtual-device CPU mesh; `tests/test_conv_block.py` pins the sharded
+behavior).
+
+Differentiation: `fused_affine_relu_conv` carries a `jax.custom_vjp`
+whose backward is XLA's autodiff of the unfused statement — it
+recomputes `z` (cheap elementwise) and uses XLA's conv-transpose /
+weight-grad contractions, which the profile shows are the efficient part
+of the stage already. Off-TPU the kernel runs in Pallas interpret mode so
+CPU tests exercise identical code.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.custom_partitioning import custom_partitioning
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+_BLOCK_B = 8  # images per grid step (VMEM budget; see microbench in DESIGN.md)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _affine_act(x, scale, shift, res, activate):
+    z = x.astype(jnp.float32) * scale + shift
+    if res is not None:
+        z = z + res.astype(jnp.float32)
+    return jnp.maximum(z, 0.0) if activate else z
+
+
+def _conv_kernel(x_ref, w_ref, scale_ref, shift_ref, y_ref, *, with_res,
+                 activate, res_ref=None):
+    # One-matmul conv: rows = (b, h, w') with w' over the padded width,
+    # K = (dh, c) built from three H-shifted slices (leading-dim slices —
+    # no layout offsets, so the lane concat is legal), N = (dw, o) — all
+    # nine taps in a single [rows,192] @ [192,192] MXU contraction. The
+    # three dw output column-blocks are then combined by row shifts: a
+    # +dw row shift within each 34-row (b,h) group realigns column block
+    # dw to its output pixel, and the zero padding of zp supplies SAME
+    # semantics. Rows with w' >= w are scratch and sliced off at the end;
+    # pltpu.roll's wrapped rows land only there.
+    bt, h, w, c = x_ref.shape
+    wp = w + 2
+    rows = bt * h * wp
+    scale = scale_ref[0, :]
+    shift = shift_ref[0, :]
+    res = res_ref[:] if with_res else None
+    z = _affine_act(x_ref[:], scale, shift, res, activate).astype(jnp.bfloat16)
+    zp = jnp.pad(z, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    win = jnp.concatenate(
+        [zp[:, dh:dh + h, :, :] for dh in range(3)], axis=-1
+    ).reshape(rows, 3 * c)
+    t = jax.lax.dot_general(
+        win, w_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc = t[:, 0:c]
+    for dw in (1, 2):
+        acc = acc + pltpu.roll(t, rows - dw, 0)[:, dw * c:(dw + 1) * c]
+    y_ref[:] = (acc.reshape(bt, h, wp, c)[:, :, 0:w, :]
+                .astype(jnp.bfloat16).astype(y_ref.dtype))
+
+
+def _pad_batch(x, block):
+    pad = (-x.shape[0]) % block
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, *x.shape[1:]), x.dtype)])
+    return x
+
+
+def _run_local(x, w, scale, shift, residual, block_b, activate):
+    """Run the kernel on (process-/shard-)local arrays."""
+    b, h, wd, c = x.shape
+    if w.shape != (3, 3, c, c):
+        raise ValueError(f"square 3x3 conv only, got weight {w.shape} "
+                         f"for input channels {c}")
+    xp = _pad_batch(x, block_b)
+    # Wcat[(dh, c_in), (dw, c_out)] = w[dh, dw, c_in, c_out]: K rows match
+    # the kernel's dh-concat of input slices, N columns put all three dw
+    # taps in one contraction.
+    w3 = w.astype(jnp.bfloat16).transpose(0, 2, 1, 3).reshape(3 * c, 3 * c)
+    scale2 = scale.astype(jnp.float32).reshape(1, c)
+    shift2 = shift.astype(jnp.float32).reshape(1, c)
+    img_spec = pl.BlockSpec((block_b, h, wd, c), lambda i: (i, 0, 0, 0),
+                            memory_space=pltpu.VMEM)
+    w_spec = pl.BlockSpec((3 * c, 3 * c), lambda i: (0, 0),
+                          memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, c), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    grid = (xp.shape[0] // block_b,)
+    out_shape = jax.ShapeDtypeStruct(xp.shape, x.dtype)
+    if residual is not None:
+        kern = functools.partial(_conv_kernel, with_res=True,
+                                 activate=activate)
+
+        def body(x_ref, w_ref, sc_ref, sh_ref, res_ref, y_ref):
+            kern(x_ref, w_ref, sc_ref, sh_ref, y_ref, res_ref=res_ref)
+
+        rp = _pad_batch(residual, block_b)
+        y = pl.pallas_call(
+            body,
+            grid=grid,
+            in_specs=[img_spec, w_spec, vec_spec, vec_spec, img_spec],
+            out_specs=img_spec,
+            out_shape=out_shape,
+            interpret=_interpret(),
+        )(xp, w3, scale2, shift2, rp)
+    else:
+        body = functools.partial(_conv_kernel, with_res=False,
+                                 activate=activate)
+        y = pl.pallas_call(
+            body,
+            grid=grid,
+            in_specs=[img_spec, w_spec, vec_spec, vec_spec],
+            out_specs=img_spec,
+            out_shape=out_shape,
+            interpret=_interpret(),
+        )(xp, w3, scale2, shift2)
+    return y[:b]
+
+
+# --- GSPMD partitioning: shard the batch dim, run the kernel per shard ---
+
+def _batch_axis(arg_infos):
+    """The mesh-axis resource the operands' batch dim is sharded over."""
+    sh = arg_infos[0].sharding
+    if sh is None or not isinstance(sh, NamedSharding) or not len(sh.spec):
+        return None
+    return sh.spec[0]
+
+
+def _make_cp(with_res):
+    if with_res:
+        def f(x, w, scale, shift, residual, block_b, activate):
+            return _run_local(x, w, scale, shift, residual, block_b, activate)
+        static = (5, 6)
+    else:
+        def f(x, w, scale, shift, block_b, activate):
+            return _run_local(x, w, scale, shift, None, block_b, activate)
+        static = (4, 5)
+    cp = custom_partitioning(f, static_argnums=static)
+
+    def infer(*cb_args):
+        mesh, arg_infos, _ = cb_args[-3:]
+        batch = _batch_axis(arg_infos)
+        return NamedSharding(mesh, P(batch, None, None, None))
+
+    def part(*cb_args):
+        block_b, activate = cb_args[:2]
+        mesh, arg_infos, _ = cb_args[-3:]
+        batch = _batch_axis(arg_infos)
+        img = NamedSharding(mesh, P(batch, None, None, None))
+        rep1 = NamedSharding(mesh, P(None))
+        arg_shardings = (img, NamedSharding(mesh, P(None, None, None, None)),
+                         rep1, rep1) + ((img,) if with_res else ())
+
+        if with_res:
+            def lower(x, w, scale, shift, residual):
+                return _run_local(x, w, scale, shift, residual, block_b,
+                                  activate)
+        else:
+            def lower(x, w, scale, shift):
+                return _run_local(x, w, scale, shift, None, block_b, activate)
+        return mesh, lower, img, arg_shardings
+
+    # Shardy mini-language: only the batch factor `b` is shared (x, residual,
+    # output), so batch sharding propagates and nothing else does.
+    rule = ("b h w c, p q i o, e, g, b r s t -> b h w c" if with_res
+            else "b h w c, p q i o, e, g -> b h w c")
+    cp.def_partition(partition=part, infer_sharding_from_operands=infer,
+                     sharding_rule=rule)
+    return cp
+
+
+_cp_conv = _make_cp(with_res=False)
+_cp_conv_res = _make_cp(with_res=True)
+
+
+def _run_fused_conv(x, w, scale, shift, residual, block_b, activate):
+    if residual is not None:
+        return _cp_conv_res(x, w, scale, shift, residual, block_b, activate)
+    return _cp_conv(x, w, scale, shift, block_b, activate)
+
+
+def _reference_z(x, scale, shift, residual, activate=True):
+    return _affine_act(x, scale.astype(jnp.float32),
+                       shift.astype(jnp.float32), residual, activate)
+
+
+def _conv3x3(z, w):
+    # bf16 operands, bf16 output — the statement Flax's nn.Conv(dtype=bf16)
+    # makes (no preferred_element_type: its conv transpose can't mix a f32
+    # cotangent with bf16 operands on this jax). The MXU accumulates in f32
+    # internally either way; the Pallas kernel keeps its f32 VMEM
+    # accumulator and rounds through bf16 on the final write to match this
+    # statement bit-for-bit.
+    return jax.lax.conv_general_dilated(
+        z.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+        window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def fused_affine_relu_conv(x, w, scale, shift, residual, block_b=_BLOCK_B,
+                           activate=True):
+    """y = conv3x3_SAME(act(x*scale + shift [+ residual]), w), fused on TPU.
+
+    x: [B,H,W,C] (any float dtype; affine computed in f32, conv in bf16),
+    w: [3,3,C,C], scale/shift: [C], residual: [B,H,W,C] or None;
+    act = ReLU when `activate` else identity. Returns y with x's dtype.
+    Differentiable in x, w, scale, shift, residual. Batch-sharded under a
+    mesh (custom partitioning); block_b is the per-grid-step image count.
+    """
+    return _run_fused_conv(x, w, scale, shift, residual, block_b, activate)
+
+
+def _fwd_rule(x, w, scale, shift, residual, block_b, activate):
+    y = _run_fused_conv(x, w, scale, shift, residual, block_b, activate)
+    return y, (x, w, scale, shift, residual)
+
+
+def _bwd_rule(block_b, activate, residuals, ct):
+    # Backward = XLA's autodiff of the unfused statement: recomputes z
+    # (cheap elementwise, fuses into the grad convs) instead of saving it,
+    # and uses XLA's conv-transpose / weight-grad contractions, which the
+    # profile shows are the efficient part of the stage already.
+    x, w, scale, shift, residual = residuals
+    ref = functools.partial(reference_affine_relu_conv, activate=activate)
+    if residual is None:
+        _, vjp = jax.vjp(ref, x, w, scale, shift)
+        dx, dw, dscale, dshift = vjp(ct)
+        dres = None
+    else:
+        _, vjp = jax.vjp(ref, x, w, scale, shift, residual)
+        dx, dw, dscale, dshift, dres = vjp(ct)
+    return dx, dw, dscale, dshift, dres
+
+
+fused_affine_relu_conv.defvjp(_fwd_rule, _bwd_rule)
+
+
+def reference_affine_relu_conv(x, w, scale, shift, residual=None,
+                               activate=True):
+    """Unfused XLA statement of the same math (oracle for tests/benches)."""
+    z = _reference_z(x, scale, shift, residual, activate)
+    return _conv3x3(z, w).astype(x.dtype)
