@@ -263,6 +263,7 @@ def measure_point(cfg: dict) -> dict:
     window = int(cfg["steps_per_call"])
     measure_steps = int(cfg["measure_steps"])
     use_pallas = bool(cfg["pallas_xent"])
+    fused_stages = str(cfg.get("fused_stages", "") or "")
     model_name = cfg.get("model", "resnet18")
     flops_per_image, num_classes = MODEL_SPECS[model_name]
     metric = metric_for(model_name, num_classes)
@@ -271,8 +272,12 @@ def measure_point(cfg: dict) -> dict:
     n_chips = int(mesh.devices.size)
     global_batch = per_chip * n_chips
 
+    from tpu_dp.models import parse_fused_stages
+
     model = build_model(model_name, num_classes=num_classes,
-                        dtype=jnp.bfloat16)
+                        dtype=jnp.bfloat16,
+                        fused_stages=parse_fused_stages(fused_stages),
+                        fused_block_b=int(cfg.get("fused_block_b", 8)))
     opt = SGD(momentum=0.9, weight_decay=5e-4)
     state = create_train_state(
         model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32), opt
@@ -373,6 +378,7 @@ def measure_point(cfg: dict) -> dict:
                 "per_chip_batch": per_chip, "steps_per_call": window,
                 "measured_steps": n_steps_timed,
                 "xent": "pallas" if use_pallas else "jnp",
+                "fused_stages": fused_stages,
             },
         }
 
@@ -483,6 +489,11 @@ def main() -> None:
                          "BASELINE config 3 (100-way head), archived under "
                          "its own metric name")
     ap.add_argument("--per-chip-batch", type=int, default=2048)
+    ap.add_argument("--fused-stages", default="",
+                    help="ResNet stages on the fused Pallas conv path "
+                         "('', '0', 'all'; tpu_dp/ops/conv_block.py)")
+    ap.add_argument("--fused-block-b", type=int, default=8,
+                    help="images per Pallas grid step (VMEM budget knob)")
     ap.add_argument("--measure-steps", type=int, default=30,
                     help="timed optimizer steps on the per-step (window=1) "
                          "path; also the schedule horizon")
@@ -530,7 +541,8 @@ def main() -> None:
           f"({info['backend']})", file=sys.stderr)
 
     base = {"measure_steps": args.measure_steps, "platform": args.platform,
-            "model": args.model}
+            "model": args.model, "fused_stages": args.fused_stages,
+            "fused_block_b": args.fused_block_b}
     if args.sweep:
         grid = [
             dict(base, per_chip_batch=b, pallas_xent=px, steps_per_call=w)
@@ -551,7 +563,9 @@ def main() -> None:
         results.append(rec)
         tag = (f"b{cfg['per_chip_batch']}/"
                f"{'pallas' if cfg['pallas_xent'] else 'jnp'}/"
-               f"w{cfg['steps_per_call']}")
+               f"w{cfg['steps_per_call']}"
+               + (f"/fused[{cfg['fused_stages']}]"
+                  if cfg.get("fused_stages") else ""))
         got = (f"{rec['value']} {UNIT}, mfu={rec.get('mfu')}"
                if rec.get("value") else rec.get("error"))
         print(f"bench: [{i + 1}/{len(grid)}] {tag}: {got}", file=sys.stderr)

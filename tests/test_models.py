@@ -92,3 +92,90 @@ def test_net_bf16_compute():
     kinds = {x.dtype for x in jax.tree_util.tree_leaves(variables["params"])}
     assert kinds == {np.dtype(np.float32)}
     assert model.apply(variables, x).dtype == jnp.float32
+
+
+class TestFusedResNet:
+    """Fused Pallas-block ResNet ≡ the standard one (tpu_dp/ops/conv_block).
+
+    The fused model must be a pure execution-strategy change: identical
+    parameter tree (checkpoint-interchangeable), bit-identical eval
+    forward, train forward within bf16 rounding, and a working train step.
+    """
+
+    def _models(self, fused_stages, **kw):
+        m0 = build_model("resnet18", num_classes=10, dtype=jnp.bfloat16, **kw)
+        m1 = build_model("resnet18", num_classes=10, dtype=jnp.bfloat16,
+                         fused_stages=fused_stages, fused_block_b=4, **kw)
+        return m0, m1
+
+    def test_param_trees_and_init_identical(self):
+        m0, m1 = self._models((0,))
+        x = np.zeros((2, 32, 32, 3), np.float32)
+        v0 = m0.init(jax.random.PRNGKey(0), x, train=False)
+        v1 = m1.init(jax.random.PRNGKey(0), x, train=False)
+        assert (jax.tree_util.tree_structure(v0)
+                == jax.tree_util.tree_structure(v1))
+        assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+            lambda a, b: bool(jnp.array_equal(a, b)), v0, v1))
+
+    @pytest.mark.parametrize("fused_stages", [(0,), (0, 1, 2, 3)])
+    def test_forward_equivalence(self, fused_stages):
+        m0, m1 = self._models(fused_stages)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3),
+                              jnp.float32)
+        v = m0.init(jax.random.PRNGKey(0), x, train=False)
+        # Eval mode: affine from running stats — must agree to bf16 exactness.
+        ye0 = m0.apply(v, x, train=False)
+        ye1 = m1.apply(v, x, train=False)
+        np.testing.assert_allclose(np.asarray(ye0, np.float32),
+                                   np.asarray(ye1, np.float32), atol=1e-6)
+        # Train mode: batch-stats path, bf16-rounding-level agreement.
+        y0, s0 = m0.apply(v, x, train=True, mutable=["batch_stats"])
+        y1, s1 = m1.apply(v, x, train=True, mutable=["batch_stats"])
+        scale = float(jnp.abs(y0).max()) + 1e-6
+        np.testing.assert_allclose(np.asarray(y0, np.float32) / scale,
+                                   np.asarray(y1, np.float32) / scale,
+                                   atol=5e-3)
+        for d in jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                lambda a, b: float(jnp.abs(a - b).max()), s0, s1)):
+            assert d < 5e-3
+
+    def test_fused_train_step(self, mesh1):
+        from tpu_dp.data.cifar import make_synthetic, normalize
+        from tpu_dp.train import (
+            SGD, constant_lr, create_train_state, make_train_step,
+        )
+
+        model = build_model("resnet18", num_classes=10, num_filters=64,
+                            dtype=jnp.bfloat16, fused_stages=(0,),
+                            fused_block_b=4)
+        opt = SGD(momentum=0.9, weight_decay=5e-4)
+        state = create_train_state(
+            model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
+            opt)
+        step = make_train_step(model, opt, mesh1, constant_lr(0.1))
+        ds = make_synthetic(8, 10, seed=0, name="fused")
+        state, m = step(state, {"image": normalize(ds.images),
+                                "label": ds.labels})
+        assert int(state.step) == 1
+        assert np.isfinite(float(m["loss"])) and float(m["loss"]) > 0
+
+    def test_resnet50_ignores_fused_stages(self):
+        # Bottleneck blocks are ineligible: flag must be a no-op, not a crash.
+        m = build_model("resnet50", num_classes=100, num_filters=8,
+                        fused_stages=(0, 1, 2, 3))
+        x = np.zeros((2, 32, 32, 3), np.float32)
+        v = m.init(jax.random.PRNGKey(0), x, train=False)
+        y = m.apply(v, x, train=False)
+        assert y.shape == (2, 100)
+
+    def test_parse_fused_stages(self):
+        from tpu_dp.models import parse_fused_stages
+
+        assert parse_fused_stages("") == ()
+        assert parse_fused_stages(None) == ()
+        assert parse_fused_stages("all") == (0, 1, 2, 3)
+        assert parse_fused_stages("0") == (0,)
+        assert parse_fused_stages("2,0") == (0, 2)
+        with pytest.raises(ValueError):
+            parse_fused_stages("one")

@@ -21,6 +21,11 @@ class ModelConfig:
     name: str = "net"  # net | resnet18 | resnet50
     num_classes: int | None = None  # None = derive from dataset; set = must agree
     bf16: bool = False  # compute dtype bfloat16 (params stay f32)
+    # Pallas fused-conv stages for ResNet-18 BasicBlocks: "" (off), "all",
+    # or comma-separated stage indices, e.g. "0" = stage 1 only
+    # (tpu_dp/ops/conv_block.py; checkpoint-compatible with the unfused model)
+    fused_stages: str = ""
+    fused_block_b: int = 8  # images per Pallas grid step (VMEM budget knob)
 
 
 @dataclass

@@ -15,16 +15,45 @@ _REGISTRY = {
     "resnet50": lambda num_classes=10, **kw: ResNet50(num_classes=num_classes, **kw),
 }
 
+# Models that understand the ResNet-only kwargs (fused Pallas stages etc.).
+_RESNETS = {"resnet18", "resnet50"}
+
+
+def parse_fused_stages(spec: str | None) -> tuple[int, ...]:
+    """Parse `ModelConfig.fused_stages`: '' -> none, 'all' -> all four
+    stages, else comma-separated stage indices ('0' or '0,1,2,3')."""
+    if not spec:
+        return ()
+    if spec.strip().lower() == "all":
+        return (0, 1, 2, 3)
+    try:
+        stages = tuple(sorted({int(s) for s in spec.split(",") if s.strip()}))
+    except ValueError:
+        raise ValueError(
+            f"fused_stages must be '', 'all', or comma-separated stage "
+            f"indices, got {spec!r}") from None
+    if any(s not in (0, 1, 2, 3) for s in stages):
+        raise ValueError(
+            f"fused_stages indices must be in 0..3, got {spec!r}")
+    return stages
+
 
 def build_model(name: str, num_classes: int = 10, **kwargs):
     """Construct a model by config name (`tpu_dp.config.ModelConfig.name`)."""
+    key = name.lower()
     try:
-        factory = _REGISTRY[name.lower()]
+        factory = _REGISTRY[key]
     except KeyError:
         raise ValueError(
             f"unknown model {name!r}; available: {sorted(_REGISTRY)}"
         ) from None
+    if key not in _RESNETS:
+        kwargs.pop("fused_stages", None)
+        kwargs.pop("fused_block_b", None)
     return factory(num_classes=num_classes, **kwargs)
 
 
-__all__ = ["Net", "ResNet", "ResNet18", "ResNet50", "build_model"]
+__all__ = [
+    "Net", "ResNet", "ResNet18", "ResNet50", "build_model",
+    "parse_fused_stages",
+]

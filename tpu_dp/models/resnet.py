@@ -25,9 +25,139 @@ from functools import partial
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
+from tpu_dp.ops.conv_block import fused_affine_relu_conv
+
 ModuleDef = Any
+
+
+class BatchNormCoeffs(nn.Module):
+    """BatchNorm that *returns* the per-channel affine instead of applying it.
+
+    Same parameter/variable layout as `nn.BatchNorm` (params ``scale``/
+    ``bias``, batch_stats ``mean``/``var``), so a model built with this
+    module loads and saves the same checkpoints as the unfused one. The
+    returned ``(scale, shift)`` satisfy ``bn(x) == x * scale + shift`` and
+    are consumed by the fused Pallas conv kernel, which applies them in
+    f32 inside VMEM (`tpu_dp.ops.conv_block`).
+
+    Stats math mirrors flax's BatchNorm: biased batch variance via
+    E[x^2] - E[x]^2 computed in f32, running stats updated with
+    ``momentum * old + (1 - momentum) * batch``; under a sharded batch the
+    global mean comes out of GSPMD's all-reduce of the jnp.mean, and the
+    explicit shard_map path syncs via ``axis_name`` (sync-BN semantics,
+    identical to the unfused model — see models docstring).
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    axis_name: str | None = None
+    scale_init: Callable = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        gamma = self.param("scale", self.scale_init, (c,), jnp.float32)
+        beta = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((c,), jnp.float32))
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=(0, 1, 2))
+            mean2 = jnp.mean(jnp.square(xf), axis=(0, 1, 2))
+            if self.axis_name is not None:
+                mean = jax.lax.pmean(mean, self.axis_name)
+                mean2 = jax.lax.pmean(mean2, self.axis_name)
+            var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+            if not self.is_initializing():
+                ra_mean.value = (self.momentum * ra_mean.value
+                                 + (1.0 - self.momentum) * mean)
+                ra_var.value = (self.momentum * ra_var.value
+                                + (1.0 - self.momentum) * var)
+        scale = gamma * jax.lax.rsqrt(var + self.epsilon)
+        shift = beta - mean * scale
+        return scale, shift
+
+
+class _ConvKernel(nn.Module):
+    """Bare 3x3 conv weight with `nn.Conv`'s param name/init, no compute.
+
+    Exists so a fused block's weights live at the same tree paths
+    (``Conv_i/kernel``) as the unfused `nn.Conv` modules — fused and
+    unfused models are checkpoint-interchangeable.
+    """
+
+    features: int
+    kernel_init: Callable
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        return self.param("kernel", self.kernel_init,
+                          (3, 3, in_features, self.features), jnp.float32)
+
+
+class FusedBasicBlock(nn.Module):
+    """BasicBlock whose convs are the fused Pallas kernel, chained in
+    "raw pre-norm" space.
+
+    Contract: the block receives ``(x_raw, in_scale, in_shift, in_res)``
+    such that its standard input activation is
+    ``a_in = relu(x_raw * in_scale + in_shift [+ in_res])`` — i.e. the
+    previous block's BN-apply tail is *deferred* into this block's first
+    fused conv, so the normalized activation never round-trips HBM. It
+    returns ``(y2_raw, out_scale, out_shift, a_in)``: the next block's
+    input in the same deferred form (its residual is this block's
+    materialized input activation). Entering a chain from a plain
+    activation ``A`` uses ``(A, ones, zeros, None)`` — exact because
+    ``relu(A) == A`` for post-ReLU activations.
+
+    Only stride-1, channel-preserving blocks qualify (the kernel is a
+    square 3x3, stride-1 conv); stride-2/projection blocks stay on the
+    standard path.
+    """
+
+    filters: int
+    norm: ModuleDef = BatchNormCoeffs
+    kernel_init: Callable = nn.initializers.variance_scaling(
+        2.0, "fan_out", "normal")
+    block_b: int = 8
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x_raw, in_scale, in_shift, in_res):
+        c = self.filters
+        if x_raw.shape[-1] != c:
+            raise ValueError(
+                f"FusedBasicBlock needs in_channels == filters, got "
+                f"{x_raw.shape[-1]} != {c}")
+        w1 = _ConvKernel(c, self.kernel_init, name="Conv_0")(c)
+        y1 = fused_affine_relu_conv(x_raw, w1, in_scale, in_shift, in_res,
+                                    self.block_b)
+        s1, b1 = self.norm(name="BatchNorm_0")(y1)
+        w2 = _ConvKernel(c, self.kernel_init, name="Conv_1")(c)
+        y2 = fused_affine_relu_conv(y1, w2, s1, b1, None, self.block_b)
+        s2, b2 = self.norm(scale_init=nn.initializers.zeros,
+                           name="BatchNorm_1")(y2)
+        # This block's input activation, materialized once for the skip
+        # connection (one elementwise pass — the only part of the BN-apply
+        # chain that still touches HBM).
+        a_in = _materialize(x_raw, in_scale, in_shift, in_res, self.dtype)
+        return y2, s2, b2, a_in
+
+
+def _materialize(x_raw, scale, shift, res, dtype):
+    # Same epilogue math as the kernel's in-VMEM transform — one source of
+    # truth so chain interior and chain exit can never drift numerically.
+    from tpu_dp.ops.conv_block import _affine_act
+
+    return _affine_act(x_raw, scale, shift, res, True).astype(dtype)
 
 
 class BasicBlock(nn.Module):
@@ -86,7 +216,16 @@ class BottleneckBlock(nn.Module):
 
 
 class ResNet(nn.Module):
-    """CIFAR-variant ResNet over NHWC inputs."""
+    """CIFAR-variant ResNet over NHWC inputs.
+
+    ``fused_stages`` selects stages whose eligible blocks (stride-1,
+    channel-preserving BasicBlocks) run as `FusedBasicBlock` chains on the
+    Pallas kernel; ineligible blocks (stride-2/projection, bottlenecks)
+    stay on the standard path and chains materialize around them. The
+    parameter tree is identical either way (blocks are explicitly named
+    ``BasicBlock_i`` in fused mode, matching the unfused auto-names), so
+    checkpoints are interchangeable between fused and unfused configs.
+    """
 
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
@@ -94,6 +233,8 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: jnp.dtype = jnp.float32
     axis_name: str | None = None  # set when used inside shard_map/pmap
+    fused_stages: Sequence[int] = ()
+    fused_block_b: int = 8
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -111,19 +252,60 @@ class ResNet(nn.Module):
             dtype=self.dtype,
             axis_name=self.axis_name,
         )
+        norm_c = partial(
+            BatchNormCoeffs,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            axis_name=self.axis_name,
+        )
+        fuse_mode = bool(self.fused_stages) and self.block_cls is BasicBlock
+        fused_set = set(self.fused_stages) if fuse_mode else set()
+
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (3, 3), name="stem_conv")(x)
-        x = norm(name="stem_norm")(x)
-        x = nn.relu(x)
+        chain = None  # (x_raw, scale, shift, residual) while chaining
+        if 0 in fused_set:
+            sc, sh = norm_c(name="stem_norm")(x)
+            chain = (x, sc, sh, None)
+        else:
+            x = norm(name="stem_norm")(x)
+            x = nn.relu(x)
+        idx = 0
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block_cls(
-                    filters=self.num_filters * 2**i,
-                    strides=strides,
-                    conv=conv,
-                    norm=norm,
-                )(x)
+                filters = self.num_filters * 2**i
+                in_ch = (chain[0] if chain is not None else x).shape[-1]
+                fusable = (i in fused_set and strides == 1
+                           and in_ch == filters)
+                if fusable:
+                    if chain is None:
+                        # Enter a chain from a plain activation A: exact,
+                        # since relu(A) == A for post-ReLU activations.
+                        chain = (x, jnp.ones((in_ch,), jnp.float32),
+                                 jnp.zeros((in_ch,), jnp.float32), None)
+                    chain = FusedBasicBlock(
+                        filters=filters,
+                        norm=norm_c,
+                        block_b=self.fused_block_b,
+                        dtype=self.dtype,
+                        name=f"BasicBlock_{idx}",
+                    )(*chain)
+                else:
+                    if chain is not None:
+                        x = _materialize(*chain, self.dtype)
+                        chain = None
+                    x = self.block_cls(
+                        filters=filters,
+                        strides=strides,
+                        conv=conv,
+                        norm=norm,
+                        name=f"BasicBlock_{idx}" if fuse_mode else None,
+                    )(x)
+                idx += 1
+        if chain is not None:
+            x = _materialize(*chain, self.dtype)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="classifier")(x)
         return x

@@ -72,8 +72,12 @@ class Trainer:
         import jax.numpy as jnp  # local: keep module import light
 
         dtype = jnp.bfloat16 if cfg.model.bf16 else jnp.float32
-        self.model = build_model(cfg.model.name, num_classes=num_classes,
-                                 dtype=dtype)
+        from tpu_dp.models import parse_fused_stages
+
+        self.model = build_model(
+            cfg.model.name, num_classes=num_classes, dtype=dtype,
+            fused_stages=parse_fused_stages(cfg.model.fused_stages),
+            fused_block_b=cfg.model.fused_block_b)
 
         self.train_pipe = DataPipeline(
             self.train_ds, cfg.data.batch_size, self.mesh,
