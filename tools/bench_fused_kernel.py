@@ -1,0 +1,122 @@
+"""Microbenchmark: fused Pallas conv kernel vs XLA's unfused chain.
+
+Measures the op this kernel replaces — BN-apply + ReLU (+residual) + 3x3
+stride-1 conv (`tpu_dp/ops/conv_block.py`) — at each ResNet stage's shape,
+against the XLA statement of the same math, on whatever backend is up
+(intended: the real TPU chip; falls back to interpret-mode on CPU, which
+is a correctness run, not a perf number).
+
+Prints one JSON line per point:
+  {"shape": [B,H,W,C], "block_b": n, "impl": "pallas"|"xla",
+   "ms": t, "tflops": f, "pct_peak": p}
+
+Usage:
+  python tools/bench_fused_kernel.py                 # stage shapes, b2048
+  python tools/bench_fused_kernel.py --batch 1024 --stages 0 --block-b 4,8
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dp.ops.conv_block import (
+    fused_affine_relu_conv,
+    reference_affine_relu_conv,
+)
+
+BF16_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,  # per chip
+}
+
+# CIFAR ResNet-18 stage shapes (H=W spatial, C channels at stride-1 blocks).
+STAGE_SHAPES = {0: (32, 64), 1: (16, 128), 2: (8, 256), 3: (4, 512)}
+
+
+def _fence(y):
+    # On the axon relay, block_until_ready can return early; fetching a
+    # scalar is the reliable completion fence (docs/DESIGN.md).
+    float(jnp.sum(y[0, 0, 0]))
+    y.block_until_ready()
+
+
+def timeit(f, *args, iters=20):
+    y = f(*args)
+    _fence(y)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = f(*args)
+    _fence(y)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--stages", default="0,1,2,3")
+    ap.add_argument("--block-b", default="4,8,16")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--with-residual", action="store_true")
+    ap.add_argument("--platform", default=None, choices=["cpu"],
+                    help="force cpu (interpret-mode correctness run; the "
+                         "env's sitecustomize pins the tpu backend, so the "
+                         "env var alone is not enough)")
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    peak = BF16_PEAK_FLOPS.get(dev.device_kind)
+    stages = [int(s) for s in args.stages.split(",")]
+    blocks = [int(b) for b in args.block_b.split(",")]
+
+    for stage in stages:
+        hw, c = STAGE_SHAPES[stage]
+        shape = (args.batch, hw, hw, c)
+        ks = jax.random.split(jax.random.PRNGKey(stage), 5)
+        x = jax.random.normal(ks[0], shape, jnp.bfloat16)
+        w = (jax.random.normal(ks[1], (3, 3, c, c)) * 0.1).astype(jnp.float32)
+        scale = jax.random.normal(ks[2], (c,)) * 0.5 + 1.0
+        shift = jax.random.normal(ks[3], (c,)) * 0.1
+        res = (jax.random.normal(ks[4], shape, jnp.bfloat16)
+               if args.with_residual else None)
+        flops = 2 * args.batch * hw * hw * c * c * 9
+
+        def emit(impl, block_b, dt):
+            rec = {"shape": list(shape), "block_b": block_b, "impl": impl,
+                   "ms": round(dt * 1e3, 3),
+                   "tflops": round(flops / dt / 1e12, 1),
+                   "pct_peak": (round(100 * flops / dt / peak, 1)
+                                if peak else None),
+                   "residual": args.with_residual,
+                   "device": dev.device_kind}
+            print(json.dumps(rec), flush=True)
+
+        ref = jax.jit(lambda x, w, r: reference_affine_relu_conv(
+            x, w, scale, shift, r))
+        emit("xla", 0, timeit(ref, x, w, res, iters=args.iters))
+
+        for bb in blocks:
+            try:
+                f = jax.jit(functools.partial(
+                    fused_affine_relu_conv, block_b=bb))
+                dt = timeit(f, x, w, scale, shift, res, iters=args.iters)
+                emit("pallas", bb, dt)
+            except Exception as e:
+                print(json.dumps({"shape": list(shape), "block_b": bb,
+                                  "impl": "pallas",
+                                  "error": f"{type(e).__name__}: {e}"[:200]}),
+                      flush=True)
+
+
+if __name__ == "__main__":
+    main()
