@@ -277,7 +277,8 @@ def measure_point(cfg: dict) -> dict:
     model = build_model(model_name, num_classes=num_classes,
                         dtype=jnp.bfloat16,
                         fused_stages=parse_fused_stages(fused_stages),
-                        fused_block_b=int(cfg.get("fused_block_b", 8)))
+                        fused_block_b=int(cfg.get("fused_block_b", 8)),
+                        fused_bwd=bool(cfg.get("fused_bwd", False)))
     opt = SGD(momentum=0.9, weight_decay=5e-4)
     state = create_train_state(
         model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32), opt
@@ -379,6 +380,7 @@ def measure_point(cfg: dict) -> dict:
                 "measured_steps": n_steps_timed,
                 "xent": "pallas" if use_pallas else "jnp",
                 "fused_stages": fused_stages,
+                "fused_bwd": bool(cfg.get("fused_bwd", False)),
             },
         }
 
@@ -494,6 +496,9 @@ def main() -> None:
                          "('', '0', 'all'; tpu_dp/ops/conv_block.py)")
     ap.add_argument("--fused-block-b", type=int, default=8,
                     help="images per Pallas grid step (VMEM budget knob)")
+    ap.add_argument("--fused-bwd", action="store_true",
+                    help="route the backward input-grad conv through the "
+                         "fused kernel too")
     ap.add_argument("--measure-steps", type=int, default=30,
                     help="timed optimizer steps on the per-step (window=1) "
                          "path; also the schedule horizon")
@@ -542,7 +547,7 @@ def main() -> None:
 
     base = {"measure_steps": args.measure_steps, "platform": args.platform,
             "model": args.model, "fused_stages": args.fused_stages,
-            "fused_block_b": args.fused_block_b}
+            "fused_block_b": args.fused_block_b, "fused_bwd": args.fused_bwd}
     if args.sweep:
         grid = [
             dict(base, per_chip_batch=b, pallas_xent=px, steps_per_call=w)

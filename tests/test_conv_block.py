@@ -76,14 +76,16 @@ def test_same_padding_edges():
 
 
 @pytest.mark.parametrize("with_res", [False, True])
-def test_grads_match_xla(with_res):
+@pytest.mark.parametrize("pallas_bwd", [False, True])
+def test_grads_match_xla(with_res, pallas_bwd):
     x, wt, scale, shift, res = _inputs(b=2)
     r = res if with_res else None
     argnums = (0, 1, 2, 3, 4) if with_res else (0, 1, 2, 3)
 
     def loss_fused(x, wt, s, b, r=None):
         return jnp.sum(
-            fused_affine_relu_conv(x, wt, s, b, r, 2).astype(jnp.float32) ** 2)
+            fused_affine_relu_conv(x, wt, s, b, r, 2, True, pallas_bwd)
+            .astype(jnp.float32) ** 2)
 
     def loss_ref(x, wt, s, b, r=None):
         return jnp.sum(

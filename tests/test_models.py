@@ -140,6 +140,35 @@ class TestFusedResNet:
                 lambda a, b: float(jnp.abs(a - b).max()), s0, s1)):
             assert d < 5e-3
 
+    def test_fused_bwd_grads_match_default(self):
+        # fused_bwd changes only the backward execution path: gradients of
+        # the same loss must agree with the XLA-backward fused model.
+        from tpu_dp.train.step import cross_entropy_loss
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3),
+                              jnp.float32)
+        labels = jnp.array([0, 1, 2, 3])
+        kw = dict(num_classes=10, num_filters=16, dtype=jnp.bfloat16,
+                  fused_stages=(0,), fused_block_b=2)
+        m0 = build_model("resnet18", **kw)
+        m1 = build_model("resnet18", fused_bwd=True, **kw)
+        v = m0.init(jax.random.PRNGKey(0), x, train=False)
+
+        def loss(model, params):
+            out, _ = model.apply(
+                {"params": params, "batch_stats": v["batch_stats"]},
+                x, train=True, mutable=["batch_stats"])
+            return cross_entropy_loss(out, labels)
+
+        g0 = jax.grad(lambda p: loss(m0, p))(v["params"])
+        g1 = jax.grad(lambda p: loss(m1, p))(v["params"])
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            m = float(jnp.abs(a).max()) + 1e-6
+            np.testing.assert_allclose(np.asarray(a, np.float32) / m,
+                                       np.asarray(b, np.float32) / m,
+                                       atol=2e-2)
+
     def test_fused_train_step(self, mesh1):
         from tpu_dp.data.cifar import make_synthetic, normalize
         from tpu_dp.train import (

@@ -26,6 +26,7 @@ class ModelConfig:
     # (tpu_dp/ops/conv_block.py; checkpoint-compatible with the unfused model)
     fused_stages: str = ""
     fused_block_b: int = 8  # images per Pallas grid step (VMEM budget knob)
+    fused_bwd: bool = False  # route the backward input-grad conv through it too
 
 
 @dataclass

@@ -50,6 +50,7 @@ def build_model(name: str, num_classes: int = 10, **kwargs):
     if key not in _RESNETS:
         kwargs.pop("fused_stages", None)
         kwargs.pop("fused_block_b", None)
+        kwargs.pop("fused_bwd", None)
     return factory(num_classes=num_classes, **kwargs)
 
 

@@ -129,6 +129,7 @@ class FusedBasicBlock(nn.Module):
         2.0, "fan_out", "normal")
     block_b: int = 8
     dtype: Any = jnp.float32
+    pallas_bwd: bool = False  # input-grad conv through the kernel too
 
     @nn.compact
     def __call__(self, x_raw, in_scale, in_shift, in_res):
@@ -139,10 +140,11 @@ class FusedBasicBlock(nn.Module):
                 f"{x_raw.shape[-1]} != {c}")
         w1 = _ConvKernel(c, self.kernel_init, name="Conv_0")(c)
         y1 = fused_affine_relu_conv(x_raw, w1, in_scale, in_shift, in_res,
-                                    self.block_b)
+                                    self.block_b, True, self.pallas_bwd)
         s1, b1 = self.norm(name="BatchNorm_0")(y1)
         w2 = _ConvKernel(c, self.kernel_init, name="Conv_1")(c)
-        y2 = fused_affine_relu_conv(y1, w2, s1, b1, None, self.block_b)
+        y2 = fused_affine_relu_conv(y1, w2, s1, b1, None, self.block_b,
+                                    True, self.pallas_bwd)
         s2, b2 = self.norm(scale_init=nn.initializers.zeros,
                            name="BatchNorm_1")(y2)
         # This block's input activation, materialized once for the skip
@@ -235,6 +237,7 @@ class ResNet(nn.Module):
     axis_name: str | None = None  # set when used inside shard_map/pmap
     fused_stages: Sequence[int] = ()
     fused_block_b: int = 8
+    fused_bwd: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -290,6 +293,7 @@ class ResNet(nn.Module):
                         norm=norm_c,
                         block_b=self.fused_block_b,
                         dtype=self.dtype,
+                        pallas_bwd=self.fused_bwd,
                         name=f"BasicBlock_{idx}",
                     )(*chain)
                 else:

@@ -39,11 +39,14 @@ as an opaque replicated op and serializes the hot path (verified on the
 behavior).
 
 Differentiation: `fused_affine_relu_conv` carries a `jax.custom_vjp`
-whose backward is XLA's autodiff of the unfused statement — it
-recomputes `z` (cheap elementwise) and uses XLA's conv-transpose /
-weight-grad contractions, which the profile shows are the efficient part
-of the stage already. Off-TPU the kernel runs in Pallas interpret mode so
-CPU tests exercise identical code.
+with a hand-written backward that recomputes `z` (cheap elementwise,
+verified against autodiff of the unfused statement in tests): the
+weight-grad contraction is XLA's; the input-grad conv is XLA's
+conv-transpose by default, or — with ``pallas_bwd`` — this same kernel
+with spatially-flipped, io-swapped weights (the input-grad of a stride-1
+SAME 3x3 conv is another stride-1 SAME 3x3 conv); the affine/ReLU
+backward is explicit elementwise math. Off-TPU the kernel runs in Pallas
+interpret mode so CPU tests exercise identical code.
 """
 
 from __future__ import annotations
@@ -262,9 +265,9 @@ def _conv3x3(z, w):
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def fused_affine_relu_conv(x, w, scale, shift, residual, block_b=_BLOCK_B,
-                           activate=True):
+                           activate=True, pallas_bwd=False):
     """y = conv3x3_SAME(act(x*scale + shift [+ residual]), w), fused on TPU.
 
     x: [B,H,W,C] (any float dtype; affine computed in f32, conv in bf16),
@@ -272,29 +275,48 @@ def fused_affine_relu_conv(x, w, scale, shift, residual, block_b=_BLOCK_B,
     act = ReLU when `activate` else identity. Returns y with x's dtype.
     Differentiable in x, w, scale, shift, residual. Batch-sharded under a
     mesh (custom partitioning); block_b is the per-grid-step image count.
+    `pallas_bwd` routes the backward input-grad conv (the same 3x3
+    stride-1 C->C shape, spatially-flipped io-swapped weights) through
+    this kernel too; the weight-grad contraction stays on XLA either way.
     """
     return _run_fused_conv(x, w, scale, shift, residual, block_b, activate)
 
 
-def _fwd_rule(x, w, scale, shift, residual, block_b, activate):
+def _fwd_rule(x, w, scale, shift, residual, block_b, activate, pallas_bwd):
     y = _run_fused_conv(x, w, scale, shift, residual, block_b, activate)
     return y, (x, w, scale, shift, residual)
 
 
-def _bwd_rule(block_b, activate, residuals, ct):
-    # Backward = XLA's autodiff of the unfused statement: recomputes z
-    # (cheap elementwise, fuses into the grad convs) instead of saving it,
-    # and uses XLA's conv-transpose / weight-grad contractions, which the
-    # profile shows are the efficient part of the stage already.
+def _bwd_rule(block_b, activate, pallas_bwd, residuals, ct):
+    # Recompute z (cheap elementwise, fuses into the grad convs) instead of
+    # saving it. The weight-grad contraction is XLA's (efficient per the
+    # profile); the input-grad conv is XLA's conv-transpose by default, or
+    # this kernel with flipped weights when pallas_bwd — identical math:
+    # conv_transpose(ct, w) == conv3x3(ct, flip_hw(w).swap_io()) at
+    # stride 1 / SAME.
     x, w, scale, shift, residual = residuals
-    ref = functools.partial(reference_affine_relu_conv, activate=activate)
-    if residual is None:
-        _, vjp = jax.vjp(ref, x, w, scale, shift)
-        dx, dw, dscale, dshift = vjp(ct)
-        dres = None
+    z = _reference_z(x, scale, shift, residual, activate)
+    # _conv3x3's primal output is bf16; the forward's final cast to x.dtype
+    # transposes to this cast of the incoming cotangent.
+    ctc = ct.astype(jnp.bfloat16)
+    if pallas_bwd:
+        # w-only vjp: no XLA dz path exists to depend on jit DCE.
+        dw = jax.vjp(lambda wi: _conv3x3(z, wi), w)[1](ctc)[0]
+        w_flip = jnp.flip(w, axis=(0, 1)).transpose(0, 1, 3, 2)
+        ones = jnp.ones((x.shape[-1],), jnp.float32)
+        zeros = jnp.zeros((x.shape[-1],), jnp.float32)
+        dz = _run_fused_conv(ctc, w_flip, ones, zeros, None, block_b,
+                             False).astype(jnp.float32)
     else:
-        _, vjp = jax.vjp(ref, x, w, scale, shift, residual)
-        dx, dw, dscale, dshift, dres = vjp(ct)
+        dz, dw = jax.vjp(_conv3x3, z, w)[1](ctc)
+        dz = dz.astype(jnp.float32)
+    # Through act and affine: gate on the post-act sign (z>0 iff pre>0).
+    dpre = dz * (z > 0) if activate else dz
+    dx = (dpre * scale.astype(jnp.float32)).astype(x.dtype)
+    dscale = jnp.sum(dpre * x.astype(jnp.float32),
+                     axis=(0, 1, 2)).astype(scale.dtype)
+    dshift = jnp.sum(dpre, axis=(0, 1, 2)).astype(shift.dtype)
+    dres = dpre.astype(residual.dtype) if residual is not None else None
     return dx, dw, dscale, dshift, dres
 
 

@@ -77,7 +77,8 @@ class Trainer:
         self.model = build_model(
             cfg.model.name, num_classes=num_classes, dtype=dtype,
             fused_stages=parse_fused_stages(cfg.model.fused_stages),
-            fused_block_b=cfg.model.fused_block_b)
+            fused_block_b=cfg.model.fused_block_b,
+            fused_bwd=cfg.model.fused_bwd)
 
         self.train_pipe = DataPipeline(
             self.train_ds, cfg.data.batch_size, self.mesh,
