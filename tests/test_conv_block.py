@@ -166,3 +166,46 @@ def test_rejects_non_3x3():
     bad = jnp.zeros((1, 1, 64, 64), jnp.float32)
     with pytest.raises(ValueError, match="3x3"):
         fused_affine_relu_conv(x, bad, scale, shift, None, 2)
+
+
+def test_emit_variant_outputs_and_grads():
+    from tpu_dp.ops.conv_block import (
+        _reference_z, fused_affine_relu_conv_emit,
+    )
+
+    x, wt, scale, shift, res = _inputs(b=4)
+    y, z = fused_affine_relu_conv_emit(x, wt, scale, shift, res, 2)
+    y0 = fused_affine_relu_conv(x, wt, scale, shift, res, 2)
+    np.testing.assert_array_equal(np.asarray(y, np.float32),
+                                  np.asarray(y0, np.float32))
+    zm = _reference_z(x, scale, shift, res).astype(jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(z, np.float32),
+                                  np.asarray(zm, np.float32))
+
+    # Gradients of a loss using BOTH outputs, vs the unfused statement.
+    def loss_fused(x, wt, s, b, r):
+        y, z = fused_affine_relu_conv_emit(x, wt, s, b, r, 2)
+        return (jnp.sum(y.astype(jnp.float32) ** 2)
+                + jnp.sum(z.astype(jnp.float32) ** 2))
+
+    def loss_ref(x, wt, s, b, r):
+        y = reference_affine_relu_conv(x, wt, s, b, r)
+        z = _reference_z(x, s, b, r).astype(jnp.bfloat16).astype(x.dtype)
+        return (jnp.sum(y.astype(jnp.float32) ** 2)
+                + jnp.sum(z.astype(jnp.float32) ** 2))
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(x, wt, scale, shift,
+                                                       res)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(x, wt, scale, shift,
+                                                     res)
+    # atol 1e-2 (bf16 ulp), not 1e-5: the oracle's two branches each round
+    # their x/res cotangent to bf16 before summing, while the fused backward
+    # sums the y- and z-path cotangents in f32 and rounds once — the fused
+    # result is the *more* accurate of the two. (The z-only path matches the
+    # oracle exactly; pinned above via the bit-equal forward outputs.)
+    for name, a, b_ in zip("x w scale shift res".split(), gf, gr):
+        a = np.asarray(a, np.float32)
+        b_ = np.asarray(b_, np.float32)
+        m = np.abs(b_).max() + 1e-6
+        np.testing.assert_allclose(a / m, b_ / m, rtol=0, atol=1e-2,
+                                   err_msg=f"grad mismatch for {name}")

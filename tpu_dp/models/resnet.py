@@ -28,7 +28,10 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from tpu_dp.ops.conv_block import fused_affine_relu_conv
+from tpu_dp.ops.conv_block import (
+    fused_affine_relu_conv,
+    fused_affine_relu_conv_emit,
+)
 
 ModuleDef = Any
 
@@ -139,27 +142,31 @@ class FusedBasicBlock(nn.Module):
                 f"FusedBasicBlock needs in_channels == filters, got "
                 f"{x_raw.shape[-1]} != {c}")
         w1 = _ConvKernel(c, self.kernel_init, name="Conv_0")(c)
-        y1 = fused_affine_relu_conv(x_raw, w1, in_scale, in_shift, in_res,
-                                    self.block_b, True, self.pallas_bwd)
+        # The emit variant writes this block's input activation (needed by
+        # the skip connection) from VMEM in the same pass as the conv — no
+        # separate read-modify-write over x_raw.
+        y1, a_in = fused_affine_relu_conv_emit(
+            x_raw, w1, in_scale, in_shift, in_res, self.block_b, True,
+            self.pallas_bwd)
+        a_in = a_in.astype(self.dtype)
         s1, b1 = self.norm(name="BatchNorm_0")(y1)
         w2 = _ConvKernel(c, self.kernel_init, name="Conv_1")(c)
         y2 = fused_affine_relu_conv(y1, w2, s1, b1, None, self.block_b,
                                     True, self.pallas_bwd)
         s2, b2 = self.norm(scale_init=nn.initializers.zeros,
                            name="BatchNorm_1")(y2)
-        # This block's input activation, materialized once for the skip
-        # connection (one elementwise pass — the only part of the BN-apply
-        # chain that still touches HBM).
-        a_in = _materialize(x_raw, in_scale, in_shift, in_res, self.dtype)
         return y2, s2, b2, a_in
 
 
 def _materialize(x_raw, scale, shift, res, dtype):
-    # Same epilogue math as the kernel's in-VMEM transform — one source of
-    # truth so chain interior and chain exit can never drift numerically.
+    # Same epilogue math AND rounding as the kernel's in-VMEM transform
+    # (f32 affine, rounded through bf16) — one source of truth so chain
+    # interior (the kernel's emitted z) and chain exit can never drift
+    # numerically, including at dtype=float32.
     from tpu_dp.ops.conv_block import _affine_act
 
-    return _affine_act(x_raw, scale, shift, res, True).astype(dtype)
+    z = _affine_act(x_raw, scale, shift, res, True)
+    return z.astype(jnp.bfloat16).astype(dtype)
 
 
 class BasicBlock(nn.Module):

@@ -75,7 +75,7 @@ def _affine_act(x, scale, shift, res, activate):
 
 
 def _conv_kernel(x_ref, w_ref, scale_ref, shift_ref, y_ref, *, with_res,
-                 activate, res_ref=None):
+                 activate, res_ref=None, z_ref=None):
     # One-matmul conv: rows = (b, h, w') with w' over the padded width,
     # K = (dh, c) built from three H-shifted slices (leading-dim slices —
     # no layout offsets, so the lane concat is legal), N = (dw, o) — all
@@ -91,7 +91,13 @@ def _conv_kernel(x_ref, w_ref, scale_ref, shift_ref, y_ref, *, with_res,
     scale = scale_ref[0, :]
     shift = shift_ref[0, :]
     res = res_ref[:] if with_res else None
-    z = _affine_act(x_ref[:], scale, shift, res, activate).astype(jnp.bfloat16)
+    zf = _affine_act(x_ref[:], scale, shift, res, activate)
+    z = zf.astype(jnp.bfloat16)
+    if z_ref is not None:
+        # The transformed activation, already resident in VMEM — written out
+        # so callers needing it (skip connections) skip a separate
+        # read-modify-write pass over HBM.
+        z_ref[:] = z.astype(z_ref.dtype)
     zp = jnp.pad(z, ((0, 0), (1, 1), (1, 1), (0, 0)))
     win = jnp.concatenate(
         [zp[:, dh:dh + h, :, :] for dh in range(3)], axis=-1
@@ -115,7 +121,8 @@ def _pad_batch(x, block):
     return x
 
 
-def _run_local(x, w, scale, shift, residual, block_b, activate):
+def _run_local(x, w, scale, shift, residual, block_b, activate,
+               emit_z=False):
     """Run the kernel on (process-/shard-)local arrays."""
     if _interpret() and getattr(jax.typeof(x), "vma", None):
         # shard_map + interpret mode (CPU tests): Pallas interpret lowers to
@@ -124,8 +131,11 @@ def _run_local(x, w, scale, shift, residual, block_b, activate):
         # (same f32 affine, same bf16 rounding) per shard instead; the
         # kernel body itself is covered by the GSPMD/single-device tests,
         # and on TPU the real (non-interpret) kernel runs under shard_map.
-        return reference_affine_relu_conv(x, w, scale, shift, residual,
-                                          activate)
+        y = reference_affine_relu_conv(x, w, scale, shift, residual, activate)
+        if emit_z:
+            z = _reference_z(x, scale, shift, residual, activate)
+            return y, z.astype(jnp.bfloat16).astype(x.dtype)
+        return y
     b, h, wd, c = x.shape
     if w.shape != (3, 3, c, c):
         raise ValueError(f"square 3x3 conv only, got weight {w.shape} "
@@ -152,35 +162,35 @@ def _run_local(x, w, scale, shift, residual, block_b, activate):
         () if residual is None else (residual,))
     vma = frozenset().union(*(getattr(jax.typeof(a), "vma", frozenset())
                               for a in operands))
-    out_shape = jax.ShapeDtypeStruct(xp.shape, x.dtype, vma=vma)
-    if residual is not None:
-        kern = functools.partial(_conv_kernel, with_res=True,
-                                 activate=activate)
+    img_shape = jax.ShapeDtypeStruct(xp.shape, x.dtype, vma=vma)
+    out_shape = [img_shape, img_shape] if emit_z else img_shape
+    out_specs = [img_spec, img_spec] if emit_z else img_spec
+    with_res = residual is not None
 
-        def body(x_ref, w_ref, sc_ref, sh_ref, res_ref, y_ref):
-            kern(x_ref, w_ref, sc_ref, sh_ref, y_ref, res_ref=res_ref)
+    def body(x_ref, w_ref, sc_ref, sh_ref, *rest):
+        res_ref = rest[0] if with_res else None
+        outs = rest[1:] if with_res else rest
+        y_ref = outs[0]
+        z_ref = outs[1] if emit_z else None
+        _conv_kernel(x_ref, w_ref, sc_ref, sh_ref, y_ref, with_res=with_res,
+                     activate=activate, res_ref=res_ref, z_ref=z_ref)
 
-        rp = _pad_batch(residual, block_b)
-        y = pl.pallas_call(
-            body,
-            grid=grid,
-            in_specs=[img_spec, w_spec, vec_spec, vec_spec, img_spec],
-            out_specs=img_spec,
-            out_shape=out_shape,
-            interpret=_interpret(),
-        )(xp, w3, scale2, shift2, rp)
-    else:
-        body = functools.partial(_conv_kernel, with_res=False,
-                                 activate=activate)
-        y = pl.pallas_call(
-            body,
-            grid=grid,
-            in_specs=[img_spec, w_spec, vec_spec, vec_spec],
-            out_specs=img_spec,
-            out_shape=out_shape,
-            interpret=_interpret(),
-        )(xp, w3, scale2, shift2)
-    return y[:b]
+    in_specs = [img_spec, w_spec, vec_spec, vec_spec]
+    args = [xp, w3, scale2, shift2]
+    if with_res:
+        in_specs.append(img_spec)
+        args.append(_pad_batch(residual, block_b))
+    out = pl.pallas_call(
+        body,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(*args)
+    if emit_z:
+        return out[0][:b], out[1][:b]
+    return out[:b]
 
 
 # --- GSPMD partitioning: shard the batch dim, run the kernel per shard ---
@@ -193,21 +203,24 @@ def _batch_axis(arg_infos):
     return sh.spec[0]
 
 
-def _make_cp(with_res):
+def _make_cp(with_res, emit_z=False):
     if with_res:
         def f(x, w, scale, shift, residual, block_b, activate):
-            return _run_local(x, w, scale, shift, residual, block_b, activate)
+            return _run_local(x, w, scale, shift, residual, block_b, activate,
+                              emit_z)
         static = (5, 6)
     else:
         def f(x, w, scale, shift, block_b, activate):
-            return _run_local(x, w, scale, shift, None, block_b, activate)
+            return _run_local(x, w, scale, shift, None, block_b, activate,
+                              emit_z)
         static = (4, 5)
     cp = custom_partitioning(f, static_argnums=static)
 
     def infer(*cb_args):
         mesh, arg_infos, _ = cb_args[-3:]
         batch = _batch_axis(arg_infos)
-        return NamedSharding(mesh, P(batch, None, None, None))
+        img = NamedSharding(mesh, P(batch, None, None, None))
+        return (img, img) if emit_z else img
 
     def part(*cb_args):
         block_b, activate = cb_args[:2]
@@ -221,29 +234,37 @@ def _make_cp(with_res):
         if with_res:
             def lower(x, w, scale, shift, residual):
                 return _run_local(x, w, scale, shift, residual, block_b,
-                                  activate)
+                                  activate, emit_z)
         else:
             def lower(x, w, scale, shift):
-                return _run_local(x, w, scale, shift, None, block_b, activate)
-        return mesh, lower, img, arg_shardings
+                return _run_local(x, w, scale, shift, None, block_b, activate,
+                                  emit_z)
+        out_shardings = (img, img) if emit_z else img
+        return mesh, lower, out_shardings, arg_shardings
 
     # Shardy mini-language: only the batch factor `b` is shared (x, residual,
-    # output), so batch sharding propagates and nothing else does.
-    rule = ("b h w c, p q i o, e, g, b r s t -> b h w c" if with_res
-            else "b h w c, p q i o, e, g -> b h w c")
+    # outputs), so batch sharding propagates and nothing else does.
+    ins = ("b h w c, p q i o, e, g, b r s t" if with_res
+           else "b h w c, p q i o, e, g")
+    outs = "b h w c, b h w c" if emit_z else "b h w c"
     cp.def_partition(partition=part, infer_sharding_from_operands=infer,
-                     sharding_rule=rule)
+                     sharding_rule=f"{ins} -> {outs}")
     return cp
 
 
 _cp_conv = _make_cp(with_res=False)
 _cp_conv_res = _make_cp(with_res=True)
+_cp_conv_z = _make_cp(with_res=False, emit_z=True)
+_cp_conv_res_z = _make_cp(with_res=True, emit_z=True)
 
 
-def _run_fused_conv(x, w, scale, shift, residual, block_b, activate):
+def _run_fused_conv(x, w, scale, shift, residual, block_b, activate,
+                    emit_z=False):
     if residual is not None:
-        return _cp_conv_res(x, w, scale, shift, residual, block_b, activate)
-    return _cp_conv(x, w, scale, shift, block_b, activate)
+        cp = _cp_conv_res_z if emit_z else _cp_conv_res
+        return cp(x, w, scale, shift, residual, block_b, activate)
+    cp = _cp_conv_z if emit_z else _cp_conv
+    return cp(x, w, scale, shift, block_b, activate)
 
 
 def _reference_z(x, scale, shift, residual, activate=True):
@@ -287,13 +308,14 @@ def _fwd_rule(x, w, scale, shift, residual, block_b, activate, pallas_bwd):
     return y, (x, w, scale, shift, residual)
 
 
-def _bwd_rule(block_b, activate, pallas_bwd, residuals, ct):
+def _bwd_core(block_b, activate, pallas_bwd, residuals, ct, ct_z=None):
     # Recompute z (cheap elementwise, fuses into the grad convs) instead of
     # saving it. The weight-grad contraction is XLA's (efficient per the
     # profile); the input-grad conv is XLA's conv-transpose by default, or
     # this kernel with flipped weights when pallas_bwd — identical math:
     # conv_transpose(ct, w) == conv3x3(ct, flip_hw(w).swap_io()) at
-    # stride 1 / SAME.
+    # stride 1 / SAME. ct_z (emit variant) is the cotangent of the
+    # emitted activation; it joins the conv's input-grad at z.
     x, w, scale, shift, residual = residuals
     z = _reference_z(x, scale, shift, residual, activate)
     # _conv3x3's primal output is bf16; the forward's final cast to x.dtype
@@ -310,6 +332,8 @@ def _bwd_rule(block_b, activate, pallas_bwd, residuals, ct):
     else:
         dz, dw = jax.vjp(_conv3x3, z, w)[1](ctc)
         dz = dz.astype(jnp.float32)
+    if ct_z is not None:
+        dz = dz + ct_z.astype(jnp.float32)
     # Through act and affine: gate on the post-act sign (z>0 iff pre>0).
     dpre = dz * (z > 0) if activate else dz
     dx = (dpre * scale.astype(jnp.float32)).astype(x.dtype)
@@ -320,7 +344,38 @@ def _bwd_rule(block_b, activate, pallas_bwd, residuals, ct):
     return dx, dw, dscale, dshift, dres
 
 
+def _bwd_rule(block_b, activate, pallas_bwd, residuals, ct):
+    return _bwd_core(block_b, activate, pallas_bwd, residuals, ct)
+
+
 fused_affine_relu_conv.defvjp(_fwd_rule, _bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def fused_affine_relu_conv_emit(x, w, scale, shift, residual,
+                                block_b=_BLOCK_B, activate=True,
+                                pallas_bwd=False):
+    """Like `fused_affine_relu_conv`, but also returns the transformed
+    activation z = act(x*scale + shift [+ residual]) as a second output,
+    written from VMEM in the same kernel pass — callers that need it (skip
+    connections) avoid a separate read-modify-write over HBM."""
+    return _run_fused_conv(x, w, scale, shift, residual, block_b, activate,
+                           emit_z=True)
+
+
+def _fwd_rule_emit(x, w, scale, shift, residual, block_b, activate,
+                   pallas_bwd):
+    y, z = _run_fused_conv(x, w, scale, shift, residual, block_b, activate,
+                           emit_z=True)
+    return (y, z), (x, w, scale, shift, residual)
+
+
+def _bwd_rule_emit(block_b, activate, pallas_bwd, residuals, cts):
+    ct_y, ct_z = cts
+    return _bwd_core(block_b, activate, pallas_bwd, residuals, ct_y, ct_z)
+
+
+fused_affine_relu_conv_emit.defvjp(_fwd_rule_emit, _bwd_rule_emit)
 
 
 def reference_affine_relu_conv(x, w, scale, shift, residual=None,
