@@ -209,3 +209,71 @@ def test_emit_variant_outputs_and_grads():
         m = np.abs(b_).max() + 1e-6
         np.testing.assert_allclose(a / m, b_ / m, rtol=0, atol=1e-2,
                                    err_msg=f"grad mismatch for {name}")
+
+
+def test_fused_conv_bn_stats_under_mesh(mesh8):
+    """Sharded batch: the partition rule must psum the per-shard stat
+    partials, so the (replicated) stats equal the global-batch sums."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_dp.ops.conv_block import _stats_of, fused_conv_bn
+
+    x, wt, scale, shift, res = _inputs(b=16)
+    xs = jax.device_put(x, NamedSharding(mesh8, P("data")))
+    rs = jax.device_put(res, NamedSharding(mesh8, P("data")))
+
+    f = jax.jit(lambda x, r: fused_conv_bn(x, wt, scale, shift, r, 2))
+    y, stats = f(xs, rs)
+    assert y.sharding.spec == P("data")
+    y_ref = fused_affine_relu_conv(x, wt, scale, shift, res, 2)
+    expected = _stats_of(np.asarray(y_ref))
+    got = np.asarray(stats)
+    scale_ref = np.abs(np.asarray(expected)).max() + 1e-6
+    np.testing.assert_allclose(got / scale_ref, np.asarray(expected) / scale_ref,
+                               atol=1e-5)
+
+
+def test_fused_conv_bn_pad_masking():
+    """Batch-pad images must not pollute the emitted stats: conv outputs of
+    zero images are NOT zero (shift/ReLU/conv), so masking is load-bearing."""
+    from tpu_dp.ops.conv_block import _stats_of, fused_conv_bn
+
+    x, wt, scale, shift, _ = _inputs(b=5)  # pads to 6 with block_b=2
+    y, stats = fused_conv_bn(x, wt, scale, shift, None, 2)
+    expected = _stats_of(np.asarray(y))
+    scale_ref = np.abs(np.asarray(expected)).max() + 1e-6
+    np.testing.assert_allclose(np.asarray(stats) / scale_ref,
+                               np.asarray(expected) / scale_ref, atol=1e-5)
+
+
+def test_fused_conv_bn_grads_through_stats():
+    """Differentiating THROUGH the stats output against an independent
+    oracle (autodiff of the unfused statement + _stats_of): a regression
+    in the hand-written stats cotangent (the 2*y factor, the f32
+    promotion) must not cancel out as it would in fused-vs-fused tests."""
+    from tpu_dp.ops.conv_block import _stats_of, fused_conv_bn
+
+    x, wt, scale, shift, res = _inputs(b=4)
+    weights = jnp.arange(2 * 64, dtype=jnp.float32).reshape(2, 64) / 64.0
+
+    def loss_fused(x, wt, s, b, r):
+        y, st = fused_conv_bn(x, wt, s, b, r, 2)
+        return jnp.sum(y.astype(jnp.float32) ** 2) + jnp.sum(st * weights)
+
+    def loss_ref(x, wt, s, b, r):
+        y = reference_affine_relu_conv(x, wt, s, b, r)
+        st = _stats_of(y.astype(jnp.bfloat16))
+        return jnp.sum(y.astype(jnp.float32) ** 2) + jnp.sum(st * weights)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(x, wt, scale, shift,
+                                                       res)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(x, wt, scale, shift,
+                                                     res)
+    # bf16-ulp tolerance: cotangent accumulation rounding differs (the
+    # fused backward sums branch cotangents in f32, the oracle per branch).
+    for name, a, b_ in zip("x w scale shift res".split(), gf, gr):
+        a = np.asarray(a, np.float32)
+        b_ = np.asarray(b_, np.float32)
+        m = np.abs(b_).max() + 1e-6
+        np.testing.assert_allclose(a / m, b_ / m, rtol=0, atol=1e-2,
+                                   err_msg=f"grad mismatch for {name}")

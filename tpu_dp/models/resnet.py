@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from tpu_dp.ops.conv_block import (
     fused_affine_relu_conv,
     fused_affine_relu_conv_emit,
+    fused_conv_bn,
 )
 
 ModuleDef = Any
@@ -61,7 +62,7 @@ class BatchNormCoeffs(nn.Module):
     scale_init: Callable = nn.initializers.ones
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, stats=None):
         c = x.shape[-1]
         gamma = self.param("scale", self.scale_init, (c,), jnp.float32)
         beta = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
@@ -72,9 +73,16 @@ class BatchNormCoeffs(nn.Module):
         if self.use_running_average:
             mean, var = ra_mean.value, ra_var.value
         else:
-            xf = x.astype(jnp.float32)
-            mean = jnp.mean(xf, axis=(0, 1, 2))
-            mean2 = jnp.mean(jnp.square(xf), axis=(0, 1, 2))
+            if stats is not None:
+                # Kernel-emitted [sum, sum_sq] of x (fused_conv_bn): x is
+                # only consulted for its shape — no reduction re-reads it.
+                count = x.shape[0] * x.shape[1] * x.shape[2]
+                mean = stats[0] / count
+                mean2 = stats[1] / count
+            else:
+                xf = x.astype(jnp.float32)
+                mean = jnp.mean(xf, axis=(0, 1, 2))
+                mean2 = jnp.mean(jnp.square(xf), axis=(0, 1, 2))
             if self.axis_name is not None:
                 mean = jax.lax.pmean(mean, self.axis_name)
                 mean2 = jax.lax.pmean(mean2, self.axis_name)
@@ -133,6 +141,7 @@ class FusedBasicBlock(nn.Module):
     block_b: int = 8
     dtype: Any = jnp.float32
     pallas_bwd: bool = False  # input-grad conv through the kernel too
+    train: bool = False  # train mode: kernel also emits BN moments
 
     @nn.compact
     def __call__(self, x_raw, in_scale, in_shift, in_res):
@@ -142,19 +151,32 @@ class FusedBasicBlock(nn.Module):
                 f"FusedBasicBlock needs in_channels == filters, got "
                 f"{x_raw.shape[-1]} != {c}")
         w1 = _ConvKernel(c, self.kernel_init, name="Conv_0")(c)
+        w2 = _ConvKernel(c, self.kernel_init, name="Conv_1")(c)
         # The emit variant writes this block's input activation (needed by
         # the skip connection) from VMEM in the same pass as the conv — no
-        # separate read-modify-write over x_raw.
-        y1, a_in = fused_affine_relu_conv_emit(
-            x_raw, w1, in_scale, in_shift, in_res, self.block_b, True,
-            self.pallas_bwd)
-        a_in = a_in.astype(self.dtype)
-        s1, b1 = self.norm(name="BatchNorm_0")(y1)
-        w2 = _ConvKernel(c, self.kernel_init, name="Conv_1")(c)
-        y2 = fused_affine_relu_conv(y1, w2, s1, b1, None, self.block_b,
+        # separate read-modify-write over x_raw. In train mode the kernel
+        # also emits each conv output's BN moments, so no stats pass
+        # re-reads y from HBM; in eval the BN affine comes from running
+        # stats and no moments are needed.
+        if self.train:
+            y1, a_in, st1 = fused_conv_bn(
+                x_raw, w1, in_scale, in_shift, in_res, self.block_b, True,
+                self.pallas_bwd, emit_z=True)
+            s1, b1 = self.norm(name="BatchNorm_0")(y1, stats=st1)
+            y2, st2 = fused_conv_bn(y1, w2, s1, b1, None, self.block_b,
                                     True, self.pallas_bwd)
-        s2, b2 = self.norm(scale_init=nn.initializers.zeros,
-                           name="BatchNorm_1")(y2)
+            s2, b2 = self.norm(scale_init=nn.initializers.zeros,
+                               name="BatchNorm_1")(y2, stats=st2)
+        else:
+            y1, a_in = fused_affine_relu_conv_emit(
+                x_raw, w1, in_scale, in_shift, in_res, self.block_b, True,
+                self.pallas_bwd)
+            s1, b1 = self.norm(name="BatchNorm_0")(y1)
+            y2 = fused_affine_relu_conv(y1, w2, s1, b1, None, self.block_b,
+                                        True, self.pallas_bwd)
+            s2, b2 = self.norm(scale_init=nn.initializers.zeros,
+                               name="BatchNorm_1")(y2)
+        a_in = a_in.astype(self.dtype)
         return y2, s2, b2, a_in
 
 
@@ -301,6 +323,7 @@ class ResNet(nn.Module):
                         block_b=self.fused_block_b,
                         dtype=self.dtype,
                         pallas_bwd=self.fused_bwd,
+                        train=train,
                         name=f"BasicBlock_{idx}",
                     )(*chain)
                 else:

@@ -75,7 +75,8 @@ def _affine_act(x, scale, shift, res, activate):
 
 
 def _conv_kernel(x_ref, w_ref, scale_ref, shift_ref, y_ref, *, with_res,
-                 activate, res_ref=None, z_ref=None):
+                 activate, res_ref=None, z_ref=None, stats_ref=None,
+                 valid_b=None):
     # One-matmul conv: rows = (b, h, w') with w' over the padded width,
     # K = (dh, c) built from three H-shifted slices (leading-dim slices —
     # no layout offsets, so the lane concat is legal), N = (dw, o) — all
@@ -110,8 +111,30 @@ def _conv_kernel(x_ref, w_ref, scale_ref, shift_ref, y_ref, *, with_res,
     acc = t[:, 0:c]
     for dw in (1, 2):
         acc = acc + pltpu.roll(t, rows - dw, 0)[:, dw * c:(dw + 1) * c]
-    y_ref[:] = (acc.reshape(bt, h, wp, c)[:, :, 0:w, :]
-                .astype(jnp.bfloat16).astype(y_ref.dtype))
+    yq = (acc.reshape(bt, h, wp, c)[:, :, 0:w, :]
+          .astype(jnp.bfloat16))
+    y_ref[:] = yq.astype(y_ref.dtype)
+    if stats_ref is not None:
+        # Per-channel [sum, sum-of-squares] of the rounded output — the
+        # moments BatchNorm needs — accumulated across grid steps while the
+        # tile is still in VMEM, so no later stats pass re-reads y from HBM.
+        # Batch-pad images (rows >= valid_b) are masked out: they are conv
+        # outputs of zero images, which are NOT zero (shift/ReLU/conv).
+        i = pl.program_id(0)
+        yf = yq.astype(jnp.float32)
+        row = jax.lax.broadcasted_iota(jnp.int32, yf.shape, 0)
+        keep = (row + i * bt < valid_b).astype(jnp.float32)
+        yf = yf * keep
+        tile = jnp.stack([jnp.sum(yf, axis=(0, 1, 2)),
+                          jnp.sum(jnp.square(yf), axis=(0, 1, 2))])
+
+        @pl.when(i == 0)
+        def _():
+            stats_ref[:] = tile
+
+        @pl.when(i != 0)
+        def _():
+            stats_ref[:] = stats_ref[:] + tile
 
 
 def _pad_batch(x, block):
@@ -121,8 +144,15 @@ def _pad_batch(x, block):
     return x
 
 
+def _stats_of(y):
+    """[sum, sum_sq] per channel of a (rounded) conv output, in f32."""
+    yf = y.astype(jnp.float32)
+    return jnp.stack([jnp.sum(yf, axis=(0, 1, 2)),
+                      jnp.sum(jnp.square(yf), axis=(0, 1, 2))])
+
+
 def _run_local(x, w, scale, shift, residual, block_b, activate,
-               emit_z=False):
+               emit_z=False, emit_stats=False):
     """Run the kernel on (process-/shard-)local arrays."""
     if _interpret() and getattr(jax.typeof(x), "vma", None):
         # shard_map + interpret mode (CPU tests): Pallas interpret lowers to
@@ -132,10 +162,13 @@ def _run_local(x, w, scale, shift, residual, block_b, activate,
         # kernel body itself is covered by the GSPMD/single-device tests,
         # and on TPU the real (non-interpret) kernel runs under shard_map.
         y = reference_affine_relu_conv(x, w, scale, shift, residual, activate)
+        out = [y]
         if emit_z:
             z = _reference_z(x, scale, shift, residual, activate)
-            return y, z.astype(jnp.bfloat16).astype(x.dtype)
-        return y
+            out.append(z.astype(jnp.bfloat16).astype(x.dtype))
+        if emit_stats:
+            out.append(_stats_of(y.astype(jnp.bfloat16)))
+        return tuple(out) if len(out) > 1 else y
     b, h, wd, c = x.shape
     if w.shape != (3, 3, c, c):
         raise ValueError(f"square 3x3 conv only, got weight {w.shape} "
@@ -163,8 +196,16 @@ def _run_local(x, w, scale, shift, residual, block_b, activate,
     vma = frozenset().union(*(getattr(jax.typeof(a), "vma", frozenset())
                               for a in operands))
     img_shape = jax.ShapeDtypeStruct(xp.shape, x.dtype, vma=vma)
-    out_shape = [img_shape, img_shape] if emit_z else img_shape
-    out_specs = [img_spec, img_spec] if emit_z else img_spec
+    out_shape = [img_shape]
+    out_specs = [img_spec]
+    if emit_z:
+        out_shape.append(img_shape)
+        out_specs.append(img_spec)
+    if emit_stats:
+        out_shape.append(jax.ShapeDtypeStruct((2, c), jnp.float32, vma=vma))
+        out_specs.append(pl.BlockSpec((2, c), lambda i: (0, 0),
+                                      memory_space=pltpu.VMEM))
+    single_out = len(out_shape) == 1
     with_res = residual is not None
 
     def body(x_ref, w_ref, sc_ref, sh_ref, *rest):
@@ -172,8 +213,10 @@ def _run_local(x, w, scale, shift, residual, block_b, activate,
         outs = rest[1:] if with_res else rest
         y_ref = outs[0]
         z_ref = outs[1] if emit_z else None
+        stats_ref = outs[-1] if emit_stats else None
         _conv_kernel(x_ref, w_ref, sc_ref, sh_ref, y_ref, with_res=with_res,
-                     activate=activate, res_ref=res_ref, z_ref=z_ref)
+                     activate=activate, res_ref=res_ref, z_ref=z_ref,
+                     stats_ref=stats_ref, valid_b=b)
 
     in_specs = [img_spec, w_spec, vec_spec, vec_spec]
     args = [xp, w3, scale2, shift2]
@@ -184,13 +227,18 @@ def _run_local(x, w, scale, shift, residual, block_b, activate,
         body,
         grid=grid,
         in_specs=in_specs,
-        out_specs=out_specs,
-        out_shape=out_shape,
+        out_specs=out_specs[0] if single_out else out_specs,
+        out_shape=out_shape[0] if single_out else out_shape,
         interpret=_interpret(),
     )(*args)
+    if single_out:
+        return out[:b]
+    outs = [out[0][:b]]
     if emit_z:
-        return out[0][:b], out[1][:b]
-    return out[:b]
+        outs.append(out[1][:b])
+    if emit_stats:
+        outs.append(out[-1])
+    return tuple(outs)
 
 
 # --- GSPMD partitioning: shard the batch dim, run the kernel per shard ---
@@ -203,24 +251,35 @@ def _batch_axis(arg_infos):
     return sh.spec[0]
 
 
-def _make_cp(with_res, emit_z=False):
+def _make_cp(with_res, emit_z=False, emit_stats=False):
     if with_res:
         def f(x, w, scale, shift, residual, block_b, activate):
             return _run_local(x, w, scale, shift, residual, block_b, activate,
-                              emit_z)
+                              emit_z, emit_stats)
         static = (5, 6)
     else:
         def f(x, w, scale, shift, block_b, activate):
             return _run_local(x, w, scale, shift, None, block_b, activate,
-                              emit_z)
+                              emit_z, emit_stats)
         static = (4, 5)
     cp = custom_partitioning(f, static_argnums=static)
+    multi = emit_z or emit_stats
+
+    def _out_shardings(mesh, batch):
+        img = NamedSharding(mesh, P(batch, None, None, None))
+        outs = [img]
+        if emit_z:
+            outs.append(img)
+        if emit_stats:
+            # Stats are per-channel sums over the *global* batch: the lower
+            # fn all-reduces the per-shard partials, so the output is
+            # replicated.
+            outs.append(NamedSharding(mesh, P(None, None)))
+        return tuple(outs) if multi else img
 
     def infer(*cb_args):
         mesh, arg_infos, _ = cb_args[-3:]
-        batch = _batch_axis(arg_infos)
-        img = NamedSharding(mesh, P(batch, None, None, None))
-        return (img, img) if emit_z else img
+        return _out_shardings(mesh, _batch_axis(arg_infos))
 
     def part(*cb_args):
         block_b, activate = cb_args[:2]
@@ -231,39 +290,49 @@ def _make_cp(with_res, emit_z=False):
         arg_shardings = (img, NamedSharding(mesh, P(None, None, None, None)),
                          rep1, rep1) + ((img,) if with_res else ())
 
+        def lower(x, w, scale, shift, residual=None):
+            out = _run_local(x, w, scale, shift, residual, block_b, activate,
+                             emit_z, emit_stats)
+            if emit_stats and batch is not None:
+                # Per-shard partial sums -> global sums over the batch axis.
+                out = out[:-1] + (jax.lax.psum(out[-1], batch),)
+            return out
+
         if with_res:
-            def lower(x, w, scale, shift, residual):
-                return _run_local(x, w, scale, shift, residual, block_b,
-                                  activate, emit_z)
+            lower_fn = lower
         else:
-            def lower(x, w, scale, shift):
-                return _run_local(x, w, scale, shift, None, block_b, activate,
-                                  emit_z)
-        out_shardings = (img, img) if emit_z else img
-        return mesh, lower, out_shardings, arg_shardings
+            def lower_fn(x, w, scale, shift):
+                return lower(x, w, scale, shift)
+        return mesh, lower_fn, _out_shardings(mesh, batch), arg_shardings
 
     # Shardy mini-language: only the batch factor `b` is shared (x, residual,
     # outputs), so batch sharding propagates and nothing else does.
     ins = ("b h w c, p q i o, e, g, b r s t" if with_res
            else "b h w c, p q i o, e, g")
-    outs = "b h w c, b h w c" if emit_z else "b h w c"
+    outs = ["b h w c"]
+    if emit_z:
+        outs.append("b h w c")
+    if emit_stats:
+        outs.append("u v")  # fresh factors: stats are replicated, never
+        # tied to the channel factor (the partition rule psums partials)
     cp.def_partition(partition=part, infer_sharding_from_operands=infer,
-                     sharding_rule=f"{ins} -> {outs}")
+                     sharding_rule=f"{ins} -> {', '.join(outs)}")
     return cp
 
 
-_cp_conv = _make_cp(with_res=False)
-_cp_conv_res = _make_cp(with_res=True)
-_cp_conv_z = _make_cp(with_res=False, emit_z=True)
-_cp_conv_res_z = _make_cp(with_res=True, emit_z=True)
+_CPS = {
+    (with_res, emit_z, emit_stats): _make_cp(with_res, emit_z, emit_stats)
+    for with_res in (False, True)
+    for emit_z in (False, True)
+    for emit_stats in (False, True)
+}
 
 
 def _run_fused_conv(x, w, scale, shift, residual, block_b, activate,
-                    emit_z=False):
+                    emit_z=False, emit_stats=False):
+    cp = _CPS[(residual is not None, emit_z, emit_stats)]
     if residual is not None:
-        cp = _cp_conv_res_z if emit_z else _cp_conv_res
         return cp(x, w, scale, shift, residual, block_b, activate)
-    cp = _cp_conv_z if emit_z else _cp_conv
     return cp(x, w, scale, shift, block_b, activate)
 
 
@@ -286,26 +355,21 @@ def _conv3x3(z, w):
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def fused_affine_relu_conv(x, w, scale, shift, residual, block_b=_BLOCK_B,
-                           activate=True, pallas_bwd=False):
-    """y = conv3x3_SAME(act(x*scale + shift [+ residual]), w), fused on TPU.
-
-    x: [B,H,W,C] (any float dtype; affine computed in f32, conv in bf16),
-    w: [3,3,C,C], scale/shift: [C], residual: [B,H,W,C] or None;
-    act = ReLU when `activate` else identity. Returns y with x's dtype.
-    Differentiable in x, w, scale, shift, residual. Batch-sharded under a
-    mesh (custom partitioning); block_b is the per-grid-step image count.
-    `pallas_bwd` routes the backward input-grad conv (the same 3x3
-    stride-1 C->C shape, spatially-flipped io-swapped weights) through
-    this kernel too; the weight-grad contraction stays on XLA either way.
-    """
-    return _run_fused_conv(x, w, scale, shift, residual, block_b, activate)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _fused_conv_vjp(x, w, scale, shift, residual, block_b, activate,
+                    pallas_bwd, emit_z, emit_stats):
+    return _run_fused_conv(x, w, scale, shift, residual, block_b, activate,
+                           emit_z, emit_stats)
 
 
-def _fwd_rule(x, w, scale, shift, residual, block_b, activate, pallas_bwd):
-    y = _run_fused_conv(x, w, scale, shift, residual, block_b, activate)
-    return y, (x, w, scale, shift, residual)
+def _fwd_rule(x, w, scale, shift, residual, block_b, activate, pallas_bwd,
+              emit_z, emit_stats):
+    out = _run_fused_conv(x, w, scale, shift, residual, block_b, activate,
+                          emit_z, emit_stats)
+    y = out[0] if (emit_z or emit_stats) else out
+    # y is saved only for the stats backward (it already exists in HBM —
+    # no extra memory or recompute).
+    return out, (x, w, scale, shift, residual, y if emit_stats else None)
 
 
 def _bwd_core(block_b, activate, pallas_bwd, residuals, ct, ct_z=None):
@@ -344,14 +408,44 @@ def _bwd_core(block_b, activate, pallas_bwd, residuals, ct, ct_z=None):
     return dx, dw, dscale, dshift, dres
 
 
-def _bwd_rule(block_b, activate, pallas_bwd, residuals, ct):
-    return _bwd_core(block_b, activate, pallas_bwd, residuals, ct)
+def _bwd_rule(block_b, activate, pallas_bwd, emit_z, emit_stats, residuals,
+              cts):
+    *core_res, y = residuals
+    ct_list = list(cts) if (emit_z or emit_stats) else [cts]
+    ct_y = ct_list[0]
+    ct_z = ct_list[1] if emit_z else None
+    if emit_stats:
+        # stats = [sum(yq), sum(yq^2)]: their cotangent joins y's before the
+        # conv backward (summed in f32, rounded once into the bf16 ct).
+        ct_stats = ct_list[-1]
+        yf = y.astype(jnp.float32)
+        ct_y = (ct_y.astype(jnp.float32)
+                + ct_stats[0][None, None, None, :]
+                + 2.0 * yf * ct_stats[1][None, None, None, :])
+    return _bwd_core(block_b, activate, pallas_bwd, tuple(core_res), ct_y,
+                     ct_z)
 
 
-fused_affine_relu_conv.defvjp(_fwd_rule, _bwd_rule)
+_fused_conv_vjp.defvjp(_fwd_rule, _bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def fused_affine_relu_conv(x, w, scale, shift, residual, block_b=_BLOCK_B,
+                           activate=True, pallas_bwd=False):
+    """y = conv3x3_SAME(act(x*scale + shift [+ residual]), w), fused on TPU.
+
+    x: [B,H,W,C] (any float dtype; affine computed in f32, conv in bf16),
+    w: [3,3,C,C], scale/shift: [C], residual: [B,H,W,C] or None;
+    act = ReLU when `activate` else identity. Returns y with x's dtype.
+    Differentiable in x, w, scale, shift, residual. Batch-sharded under a
+    mesh (custom partitioning); block_b is the per-grid-step image count.
+    `pallas_bwd` routes the backward input-grad conv (the same 3x3
+    stride-1 C->C shape, spatially-flipped io-swapped weights) through
+    this kernel too; the weight-grad contraction stays on XLA either way.
+    """
+    return _fused_conv_vjp(x, w, scale, shift, residual, block_b, activate,
+                           pallas_bwd, False, False)
+
+
 def fused_affine_relu_conv_emit(x, w, scale, shift, residual,
                                 block_b=_BLOCK_B, activate=True,
                                 pallas_bwd=False):
@@ -359,23 +453,25 @@ def fused_affine_relu_conv_emit(x, w, scale, shift, residual,
     activation z = act(x*scale + shift [+ residual]) as a second output,
     written from VMEM in the same kernel pass — callers that need it (skip
     connections) avoid a separate read-modify-write over HBM."""
-    return _run_fused_conv(x, w, scale, shift, residual, block_b, activate,
-                           emit_z=True)
+    return _fused_conv_vjp(x, w, scale, shift, residual, block_b, activate,
+                           pallas_bwd, True, False)
 
 
-def _fwd_rule_emit(x, w, scale, shift, residual, block_b, activate,
-                   pallas_bwd):
-    y, z = _run_fused_conv(x, w, scale, shift, residual, block_b, activate,
-                           emit_z=True)
-    return (y, z), (x, w, scale, shift, residual)
+def fused_conv_bn(x, w, scale, shift, residual, block_b=_BLOCK_B,
+                  activate=True, pallas_bwd=False, emit_z=False):
+    """Fused conv that also emits BatchNorm moments of its output.
 
-
-def _bwd_rule_emit(block_b, activate, pallas_bwd, residuals, cts):
-    ct_y, ct_z = cts
-    return _bwd_core(block_b, activate, pallas_bwd, residuals, ct_y, ct_z)
-
-
-fused_affine_relu_conv_emit.defvjp(_fwd_rule_emit, _bwd_rule_emit)
+    Returns ``(y, [z,] stats)`` where ``stats`` is the per-channel
+    ``[sum(y), sum(y^2)]`` (f32), accumulated in VMEM while each tile is
+    produced — the moments `BatchNormCoeffs` needs, without the separate
+    XLA reduction pass that would re-read y from HBM (batch-pad images are
+    masked out). Under a sharded mesh the partition rule all-reduces the
+    per-shard partials, so stats are global sums (sync-BN); under
+    shard_map they are the shard's partials, to be `pmean`'d by the
+    caller via ``axis_name`` — the same split the unfused BatchNorm has.
+    """
+    return _fused_conv_vjp(x, w, scale, shift, residual, block_b, activate,
+                           pallas_bwd, emit_z, True)
 
 
 def reference_affine_relu_conv(x, w, scale, shift, residual=None,
