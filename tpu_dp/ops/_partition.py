@@ -1,0 +1,50 @@
+"""Shared GSPMD/shard_map plumbing for the Pallas op modules.
+
+One copy of the custom-partitioning support code used by both
+`tpu_dp.ops.conv_block` and `tpu_dp.ops.xent`: backend detection, the
+batch-axis extraction from operand shardings, batch padding, the
+varying-mesh-axes (vma) union for `shard_map`'s check_vma, and the guard
+for the interpret-mode fallback (Pallas interpret lowers to a grid scan
+whose index scalars are vma-unvarying, which check_vma rejects — per-shard
+code falls back to the op's identical XLA statement there).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+
+def interpret() -> bool:
+    """True off-TPU: run kernels in Pallas interpret mode."""
+    return jax.default_backend() != "tpu"
+
+
+def shard_map_interp(x) -> bool:
+    """True when per-shard interpret-mode code must take the XLA fallback."""
+    return interpret() and bool(getattr(jax.typeof(x), "vma", None))
+
+
+def batch_axis(arg_infos):
+    """The mesh-axis resource operand 0's leading (batch) dim is sharded
+    over, or None."""
+    sh = arg_infos[0].sharding
+    if sh is None or not isinstance(sh, NamedSharding) or not len(sh.spec):
+        return None
+    return sh.spec[0]
+
+
+def pad_batch(x, block):
+    """Zero-pad the leading dim up to a multiple of ``block``."""
+    pad = (-x.shape[0]) % block
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, *x.shape[1:]), x.dtype)])
+    return x
+
+
+def vma_of(*arrays):
+    """Union of the mesh axes the arrays vary over (empty outside
+    shard_map)."""
+    return frozenset().union(*(getattr(jax.typeof(a), "vma", frozenset())
+                               for a in arrays))

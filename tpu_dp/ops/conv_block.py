@@ -60,11 +60,15 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.experimental.custom_partitioning import custom_partitioning
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tpu_dp.ops._partition import (
+    batch_axis as _batch_axis,
+    interpret as _interpret,
+    pad_batch as _pad_batch,
+    shard_map_interp as _shard_map_interp,
+    vma_of as _vma_of,
+)
+
 _BLOCK_B = 8  # images per grid step (VMEM budget; see microbench in DESIGN.md)
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _affine_act(x, scale, shift, res, activate):
@@ -137,13 +141,6 @@ def _conv_kernel(x_ref, w_ref, scale_ref, shift_ref, y_ref, *, with_res,
             stats_ref[:] = stats_ref[:] + tile
 
 
-def _pad_batch(x, block):
-    pad = (-x.shape[0]) % block
-    if pad:
-        x = jnp.concatenate([x, jnp.zeros((pad, *x.shape[1:]), x.dtype)])
-    return x
-
-
 def _stats_of(y):
     """[sum, sum_sq] per channel of a (rounded) conv output, in f32."""
     yf = y.astype(jnp.float32)
@@ -154,7 +151,7 @@ def _stats_of(y):
 def _run_local(x, w, scale, shift, residual, block_b, activate,
                emit_z=False, emit_stats=False):
     """Run the kernel on (process-/shard-)local arrays."""
-    if _interpret() and getattr(jax.typeof(x), "vma", None):
+    if _shard_map_interp(x):
         # shard_map + interpret mode (CPU tests): Pallas interpret lowers to
         # a grid scan whose internal index scalars are vma-unvarying, which
         # check_vma rejects. Run the numerically-identical XLA statement
@@ -193,8 +190,7 @@ def _run_local(x, w, scale, shift, residual, block_b, activate,
     # equivalent to not passing it).
     operands = (xp, w3, scale2, shift2) + (
         () if residual is None else (residual,))
-    vma = frozenset().union(*(getattr(jax.typeof(a), "vma", frozenset())
-                              for a in operands))
+    vma = _vma_of(*operands)
     img_shape = jax.ShapeDtypeStruct(xp.shape, x.dtype, vma=vma)
     out_shape = [img_shape]
     out_specs = [img_spec]
@@ -242,14 +238,6 @@ def _run_local(x, w, scale, shift, residual, block_b, activate,
 
 
 # --- GSPMD partitioning: shard the batch dim, run the kernel per shard ---
-
-def _batch_axis(arg_infos):
-    """The mesh-axis resource the operands' batch dim is sharded over."""
-    sh = arg_infos[0].sharding
-    if sh is None or not isinstance(sh, NamedSharding) or not len(sh.spec):
-        return None
-    return sh.spec[0]
-
 
 def _make_cp(with_res, emit_z=False, emit_stats=False):
     if with_res:

@@ -331,6 +331,7 @@ def make_train_step_shard_map(
     optimizer: Optimizer,
     mesh: Mesh,
     schedule: Schedule,
+    use_pallas_xent: bool = False,
 ) -> Callable:
     """Explicit-collectives variant of the DP train step (`shard_map`).
 
@@ -358,6 +359,7 @@ def make_train_step_shard_map(
     repl_spec = P()
     batch_spec = P(DATA_AXIS)
     world = int(mesh.devices.size)
+    loss_impl = _select_loss_impl(use_pallas_xent)
 
     def local_step(state: TrainState, batch):
         images, labels = _maybe_normalize(batch["image"]), batch["label"]
@@ -373,7 +375,7 @@ def make_train_step_shard_map(
             lambda p: _to_varying(p, DATA_AXIS), state.params
         )
         loss, grads, new_batch_stats, correct = _forward_backward(
-            model, cross_entropy_loss, state.replace(params=local_params),
+            model, loss_impl, state.replace(params=local_params),
             images, labels
         )
 
