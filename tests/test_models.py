@@ -245,3 +245,46 @@ class TestFusedResNet:
         # rounding, verified bit-identical in eager forward.
         assert float(m_sm["loss"]) == pytest.approx(float(m_g["loss"]),
                                                     rel=2e-4)
+
+    def test_checkpoint_interchangeable_unfused_to_fused(self, tmp_path,
+                                                         mesh1):
+        """The interchangeability claim end to end: a checkpoint saved from
+        an UNFUSED run restores into a FUSED model (and trains a step) —
+        the fused path is an execution strategy, not a different model."""
+        from tpu_dp.checkpoint import load_checkpoint, save_checkpoint
+        from tpu_dp.data.cifar import make_synthetic, normalize
+        from tpu_dp.train import (
+            SGD, constant_lr, create_train_state, make_train_step,
+        )
+
+        mesh = mesh1
+        opt = SGD(momentum=0.9)
+        x0 = np.zeros((1, 32, 32, 3), np.float32)
+        ds = make_synthetic(8, 10, seed=0, name="ckpt_x")
+        batch = {"image": normalize(ds.images), "label": ds.labels}
+
+        m0 = build_model("resnet18", num_classes=10, num_filters=16,
+                         dtype=jnp.bfloat16)
+        s0 = create_train_state(m0, jax.random.PRNGKey(0), x0, opt)
+        s0, _ = make_train_step(m0, opt, mesh, constant_lr(0.1))(
+            s0, dict(batch))
+        save_checkpoint(tmp_path, s0, {"step": 1})
+
+        m1 = build_model("resnet18", num_classes=10, num_filters=16,
+                         dtype=jnp.bfloat16, fused_stages=(0,),
+                         fused_block_b=2)
+        s1 = create_train_state(m1, jax.random.PRNGKey(7), x0, opt)
+        restored, meta = load_checkpoint(tmp_path, s1)
+        assert meta["step"] == 1
+        # Bit-identical restore of the unfused run's FULL state (params,
+        # momentum buffers, batch_stats, step) into the fused model's tree.
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            restored, jax.device_get(s0))
+        # ...and the fused model trains from it.
+        restored = jax.tree_util.tree_map(jnp.asarray, restored)
+        s2, metrics = make_train_step(m1, opt, mesh, constant_lr(0.1))(
+            restored, dict(batch))
+        assert int(s2.step) == 2
+        assert np.isfinite(float(metrics["loss"]))
