@@ -189,14 +189,37 @@ class TestFusedResNet:
         assert int(state.step) == 1
         assert np.isfinite(float(m["loss"])) and float(m["loss"]) > 0
 
-    def test_resnet50_ignores_fused_stages(self):
-        # Bottleneck blocks are ineligible: flag must be a no-op, not a crash.
-        m = build_model("resnet50", num_classes=100, num_filters=8,
-                        fused_stages=(0, 1, 2, 3))
-        x = np.zeros((2, 32, 32, 3), np.float32)
-        v = m.init(jax.random.PRNGKey(0), x, train=False)
-        y = m.apply(v, x, train=False)
-        assert y.shape == (2, 100)
+    def test_resnet50_fused_bottleneck_equivalence(self):
+        """ResNet-50's stride-1 bottlenecks run their middle 3x3 on the
+        kernel: same param tree (checkpoint-interchangeable), bit-identical
+        eval forward, train forward within bf16 rounding."""
+        kw = dict(num_classes=100, num_filters=16, dtype=jnp.bfloat16)
+        m0 = build_model("resnet50", **kw)
+        m1 = build_model("resnet50", fused_stages=(0, 1, 2, 3),
+                         fused_block_b=2, **kw)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3),
+                              jnp.float32)
+        v0 = m0.init(jax.random.PRNGKey(0), x, train=False)
+        v1 = m1.init(jax.random.PRNGKey(0), x, train=False)
+        assert (jax.tree_util.tree_structure(v0)
+                == jax.tree_util.tree_structure(v1))
+        assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+            lambda a, b: bool(jnp.array_equal(a, b)), v0, v1))
+        ye0 = m0.apply(v0, x, train=False)
+        ye1 = m1.apply(v0, x, train=False)
+        np.testing.assert_allclose(np.asarray(ye0, np.float32),
+                                   np.asarray(ye1, np.float32), atol=1e-6)
+        y0, st0 = m0.apply(v0, x, train=True, mutable=["batch_stats"])
+        y1, st1 = m1.apply(v0, x, train=True, mutable=["batch_stats"])
+        s = float(jnp.abs(y0).max()) + 1e-6
+        np.testing.assert_allclose(np.asarray(y0, np.float32) / s,
+                                   np.asarray(y1, np.float32) / s,
+                                   atol=5e-3)
+        # Running-stat updates (incl. BatchNorm_1 fed by kernel-emitted
+        # moments) must track the unfused model too.
+        for d in jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                lambda a, b: float(jnp.abs(a - b).max()), st0, st1)):
+            assert d < 5e-3
 
     def test_parse_fused_stages(self):
         from tpu_dp.models import parse_fused_stages

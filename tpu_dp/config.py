@@ -21,7 +21,8 @@ class ModelConfig:
     name: str = "net"  # net | resnet18 | resnet50
     num_classes: int | None = None  # None = derive from dataset; set = must agree
     bf16: bool = False  # compute dtype bfloat16 (params stay f32)
-    # Pallas fused-conv stages for ResNet-18 BasicBlocks: "" (off), "all",
+    # Pallas fused-conv stages for ResNet blocks (BasicBlock chains,
+    # Bottleneck middle-3x3s): "" (off), "all",
     # or comma-separated stage indices, e.g. "0" = stage 1 only
     # (tpu_dp/ops/conv_block.py; checkpoint-compatible with the unfused model)
     fused_stages: str = ""

@@ -180,6 +180,57 @@ class FusedBasicBlock(nn.Module):
         return y2, s2, b2, a_in
 
 
+class FusedBottleneckBlock(nn.Module):
+    """BottleneckBlock whose middle 3x3 runs on the fused Pallas kernel.
+
+    Unlike `FusedBasicBlock` there is no cross-block chaining (the 1x1
+    reduce/expand convs bound the kernel's shape), but the block-local
+    fusion still wins the big pieces: the 3x3 — ~64% of the block's FLOPs
+    at C_in == C_out == filters — takes the one-matmul kernel with bn1's
+    apply+ReLU folded into its input transform, and in train mode the
+    kernel emits bn2's moments, so neither bn1's normalize pass nor bn2's
+    stats pass touches HBM. Parameter tree matches the standard block
+    (Conv_i / BatchNorm_i / shortcut_*), so checkpoints are
+    interchangeable. Only stride-1 blocks qualify.
+    """
+
+    filters: int
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    norm_coeffs: ModuleDef = BatchNormCoeffs
+    act: Callable = nn.relu
+    kernel_init: Callable = nn.initializers.variance_scaling(
+        2.0, "fan_out", "normal")
+    block_b: int = 8
+    pallas_bwd: bool = False
+    train: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), name="Conv_0")(x)
+        s1, b1 = self.norm_coeffs(name="BatchNorm_0")(y)
+        w2 = _ConvKernel(self.filters, self.kernel_init, name="Conv_1")(
+            self.filters)
+        if self.train:
+            y2, st2 = fused_conv_bn(y, w2, s1, b1, None, self.block_b,
+                                    True, self.pallas_bwd)
+            s2, b2 = self.norm_coeffs(name="BatchNorm_1")(y2, stats=st2)
+        else:
+            y2 = fused_affine_relu_conv(y, w2, s1, b1, None, self.block_b,
+                                        True, self.pallas_bwd)
+            s2, b2 = self.norm_coeffs(name="BatchNorm_1")(y2)
+        z2 = _materialize(y2, s2, b2, None, y2.dtype)
+        y3 = self.conv(self.filters * 4, (1, 1), name="Conv_2")(z2)
+        y3 = self.norm(scale_init=nn.initializers.zeros,
+                       name="BatchNorm_2")(y3)
+        if residual.shape != y3.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 name="shortcut_conv")(residual)
+            residual = self.norm(name="shortcut_norm")(residual)
+        return self.act(y3 + residual)
+
+
 def _materialize(x_raw, scale, shift, res, dtype):
     # Same epilogue math AND rounding as the kernel's in-VMEM transform
     # (f32 affine, rounded through bf16) — one source of truth so chain
@@ -249,13 +300,15 @@ class BottleneckBlock(nn.Module):
 class ResNet(nn.Module):
     """CIFAR-variant ResNet over NHWC inputs.
 
-    ``fused_stages`` selects stages whose eligible blocks (stride-1,
-    channel-preserving BasicBlocks) run as `FusedBasicBlock` chains on the
-    Pallas kernel; ineligible blocks (stride-2/projection, bottlenecks)
-    stay on the standard path and chains materialize around them. The
-    parameter tree is identical either way (blocks are explicitly named
-    ``BasicBlock_i`` in fused mode, matching the unfused auto-names), so
-    checkpoints are interchangeable between fused and unfused configs.
+    ``fused_stages`` selects stages whose eligible blocks run on the
+    Pallas kernel: stride-1 channel-preserving BasicBlocks become
+    `FusedBasicBlock` chains, and stride-1 BottleneckBlocks run their
+    middle 3x3 as a `FusedBottleneckBlock` (block-local fusion).
+    Ineligible blocks (stride-2/projection) stay on the standard path and
+    chains materialize around them. The parameter tree is identical either
+    way (blocks are explicitly named ``BasicBlock_i``/``BottleneckBlock_i``
+    in fused mode, matching the unfused auto-names), so checkpoints are
+    interchangeable between fused and unfused configs.
     """
 
     stage_sizes: Sequence[int]
@@ -291,13 +344,16 @@ class ResNet(nn.Module):
             epsilon=1e-5,
             axis_name=self.axis_name,
         )
-        fuse_mode = bool(self.fused_stages) and self.block_cls is BasicBlock
+        fuse_basic = bool(self.fused_stages) and self.block_cls is BasicBlock
+        fuse_bneck = (bool(self.fused_stages)
+                      and self.block_cls is BottleneckBlock)
+        fuse_mode = fuse_basic or fuse_bneck
         fused_set = set(self.fused_stages) if fuse_mode else set()
 
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (3, 3), name="stem_conv")(x)
         chain = None  # (x_raw, scale, shift, residual) while chaining
-        if 0 in fused_set:
+        if fuse_basic and 0 in fused_set:
             sc, sh = norm_c(name="stem_norm")(x)
             chain = (x, sc, sh, None)
         else:
@@ -309,9 +365,20 @@ class ResNet(nn.Module):
                 strides = 2 if i > 0 and j == 0 else 1
                 filters = self.num_filters * 2**i
                 in_ch = (chain[0] if chain is not None else x).shape[-1]
-                fusable = (i in fused_set and strides == 1
+                fusable = (fuse_basic and i in fused_set and strides == 1
                            and in_ch == filters)
-                if fusable:
+                if fuse_bneck and i in fused_set and strides == 1:
+                    x = FusedBottleneckBlock(
+                        filters=filters,
+                        conv=conv,
+                        norm=norm,
+                        norm_coeffs=norm_c,
+                        block_b=self.fused_block_b,
+                        pallas_bwd=self.fused_bwd,
+                        train=train,
+                        name=f"BottleneckBlock_{idx}",
+                    )(x)
+                elif fusable:
                     if chain is None:
                         # Enter a chain from a plain activation A: exact,
                         # since relu(A) == A for post-ReLU activations.
@@ -330,12 +397,17 @@ class ResNet(nn.Module):
                     if chain is not None:
                         x = _materialize(*chain, self.dtype)
                         chain = None
+                    block_name = None
+                    if fuse_basic:
+                        block_name = f"BasicBlock_{idx}"
+                    elif fuse_bneck:
+                        block_name = f"BottleneckBlock_{idx}"
                     x = self.block_cls(
                         filters=filters,
                         strides=strides,
                         conv=conv,
                         norm=norm,
-                        name=f"BasicBlock_{idx}" if fuse_mode else None,
+                        name=block_name,
                     )(x)
                 idx += 1
         if chain is not None:
