@@ -277,3 +277,16 @@ def test_fused_conv_bn_grads_through_stats():
         m = np.abs(b_).max() + 1e-6
         np.testing.assert_allclose(a / m, b_ / m, rtol=0, atol=1e-2,
                                    err_msg=f"grad mismatch for {name}")
+
+
+def test_rectangular_spatial():
+    # H != W: the row-shift realignment is width-stride-specific, so a
+    # rectangular case guards the indexing math.
+    x, wt, scale, shift, _ = _inputs(b=3, h=6, w=10, seed=9)
+    y = fused_affine_relu_conv(x, wt, scale, shift, None, 2)
+    yr = reference_affine_relu_conv(x, wt, scale, shift, None)
+    # atol = one bf16 ulp at this magnitude (accumulation-order rounding).
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(yr, np.float32),
+        rtol=0, atol=1e-2,
+    )
