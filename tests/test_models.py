@@ -311,3 +311,27 @@ class TestFusedResNet:
             restored, dict(batch))
         assert int(s2.step) == 2
         assert np.isfinite(float(metrics["loss"]))
+
+    def test_resnet50_fused_train_step_mesh8(self, mesh8):
+        """Fused bottlenecks under the 8-device GSPMD mesh: the kernel's
+        partitioning (incl. the stats psum) must compose with the sharded
+        train step."""
+        from tpu_dp.data.cifar import make_synthetic, normalize
+        from tpu_dp.train import (
+            SGD, constant_lr, create_train_state, make_train_step,
+        )
+
+        model = build_model("resnet50", num_classes=100, num_filters=8,
+                            dtype=jnp.bfloat16, fused_stages=(0,),
+                            fused_block_b=2)
+        opt = SGD(momentum=0.9)
+        state = create_train_state(
+            model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3),
+                                                   np.float32), opt)
+        step = make_train_step(model, opt, mesh8, constant_lr(0.1))
+        ds = make_synthetic(16, 100, seed=0, name="r50_mesh")
+        state, m = step(state, {"image": normalize(ds.images),
+                                "label": ds.labels})
+        assert int(state.step) == 1
+        assert np.isfinite(float(m["loss"])) and float(m["loss"]) > 0
+        assert int(m["count"]) == 16
