@@ -484,6 +484,10 @@ def main() -> None:
     ap.add_argument("--sweep", action="store_true",
                     help="sweep batch x xent-impl x window instead of the "
                          "single headline point")
+    ap.add_argument("--sweep-fused", action="store_true",
+                    help="sweep the fused Pallas conv-path variants "
+                         "(fused_stages x fused_bwd) at the headline "
+                         "batch, window=1")
     ap.add_argument("--platform", default=None, choices=["cpu"],
                     help="force the cpu backend (harness smoke test)")
     ap.add_argument("--model", default="resnet18", choices=sorted(MODEL_SPECS),
@@ -510,6 +514,9 @@ def main() -> None:
     ap.add_argument("--point-timeout", type=float, default=900.0)
     ap.add_argument("--_measure", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.sweep and args.sweep_fused:
+        ap.error("--sweep and --sweep-fused are mutually exclusive; "
+                 "run them as two invocations (both archive)")
 
     if args._measure is not None:
         emit(measure_point(json.loads(args._measure)))
@@ -555,6 +562,14 @@ def main() -> None:
             for px in (False, True)
             for w in (1, 30)
         ]
+    elif args.sweep_fused:
+        variants = [("", False), ("0", False), ("all", False),
+                    ("0", True), ("all", True)]
+        grid = [
+            dict(base, per_chip_batch=args.per_chip_batch, pallas_xent=False,
+                 steps_per_call=1, fused_stages=fs, fused_bwd=fb)
+            for fs, fb in variants
+        ]
     else:
         grid = [dict(base, per_chip_batch=args.per_chip_batch,
                      pallas_xent=False, steps_per_call=args.steps_per_call)]
@@ -569,7 +584,8 @@ def main() -> None:
         tag = (f"b{cfg['per_chip_batch']}/"
                f"{'pallas' if cfg['pallas_xent'] else 'jnp'}/"
                f"w{cfg['steps_per_call']}"
-               + (f"/fused[{cfg['fused_stages']}]"
+               + (f"/fused[{cfg['fused_stages']}"
+                  f"{'+bwd' if cfg.get('fused_bwd') else ''}]"
                   if cfg.get("fused_stages") else ""))
         got = (f"{rec['value']} {UNIT}, mfu={rec.get('mfu')}"
                if rec.get("value") else rec.get("error"))
