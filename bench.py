@@ -277,7 +277,7 @@ def measure_point(cfg: dict) -> dict:
     model = build_model(model_name, num_classes=num_classes,
                         dtype=jnp.bfloat16,
                         fused_stages=parse_fused_stages(fused_stages),
-                        fused_block_b=int(cfg.get("fused_block_b", 8)),
+                        fused_block_b=int(cfg.get("fused_block_b", 0)),
                         fused_bwd=bool(cfg.get("fused_bwd", False)))
     opt = SGD(momentum=0.9, weight_decay=5e-4)
     state = create_train_state(
@@ -498,8 +498,8 @@ def main() -> None:
     ap.add_argument("--fused-stages", default="",
                     help="ResNet stages on the fused Pallas conv path "
                          "('', '0', 'all'; tpu_dp/ops/conv_block.py)")
-    ap.add_argument("--fused-block-b", type=int, default=8,
-                    help="images per Pallas grid step (VMEM budget knob)")
+    ap.add_argument("--fused-block-b", type=int, default=0,
+                    help="images per Pallas grid step (0 = auto from VMEM budget)")
     ap.add_argument("--fused-bwd", action="store_true",
                     help="route the backward input-grad conv through the "
                          "fused kernel too")

@@ -26,7 +26,7 @@ class ModelConfig:
     # or comma-separated stage indices, e.g. "0" = stage 1 only
     # (tpu_dp/ops/conv_block.py; checkpoint-compatible with the unfused model)
     fused_stages: str = ""
-    fused_block_b: int = 8  # images per Pallas grid step (VMEM budget knob)
+    fused_block_b: int = 0  # images per Pallas grid step; 0 = auto from VMEM budget
     fused_bwd: bool = False  # route the backward input-grad conv through it too
 
 

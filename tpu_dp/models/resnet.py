@@ -138,7 +138,7 @@ class FusedBasicBlock(nn.Module):
     norm: ModuleDef = BatchNormCoeffs
     kernel_init: Callable = nn.initializers.variance_scaling(
         2.0, "fan_out", "normal")
-    block_b: int = 8
+    block_b: int = 0  # 0 = auto
     dtype: Any = jnp.float32
     pallas_bwd: bool = False  # input-grad conv through the kernel too
     train: bool = False  # train mode: kernel also emits BN moments
@@ -201,7 +201,7 @@ class FusedBottleneckBlock(nn.Module):
     act: Callable = nn.relu
     kernel_init: Callable = nn.initializers.variance_scaling(
         2.0, "fan_out", "normal")
-    block_b: int = 8
+    block_b: int = 0  # 0 = auto
     pallas_bwd: bool = False
     train: bool = False
 
@@ -318,7 +318,7 @@ class ResNet(nn.Module):
     dtype: jnp.dtype = jnp.float32
     axis_name: str | None = None  # set when used inside shard_map/pmap
     fused_stages: Sequence[int] = ()
-    fused_block_b: int = 8
+    fused_block_b: int = 0  # 0 = auto from the VMEM budget
     fused_bwd: bool = False
 
     @nn.compact

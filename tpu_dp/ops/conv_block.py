@@ -68,7 +68,30 @@ from tpu_dp.ops._partition import (
     vma_of as _vma_of,
 )
 
-_BLOCK_B = 8  # images per grid step (VMEM budget; see microbench in DESIGN.md)
+_BLOCK_B = 0  # default: auto (pick images/grid-step from the VMEM budget)
+_VMEM_BUDGET_BYTES = 12 * 2**20  # leave headroom under the ~16MB VMEM
+
+
+def _pad128(n: int) -> int:
+    return -(-n // 128) * 128
+
+
+def _auto_block_b(h: int, w: int, c: int) -> int:
+    """Images per grid step that keep the kernel's working set under the
+    VMEM budget: per image the kernel holds x, zp, the dh-concat win, the
+    f32 matmul output t (lanes padded to 128), the f32 acc slice, and the
+    y (+z) outputs — stage-1 shapes (~2.5 MB/image at 32x32x64) fit 4,
+    later stages progressively more."""
+    wp = w + 2
+    per_img = (
+        h * w * c * 2              # x block
+        + (h + 2) * wp * c * 2     # zp
+        + h * wp * 3 * c * 2       # win
+        + h * wp * _pad128(3 * c) * 4   # t (f32)
+        + h * wp * _pad128(c) * 4       # acc (f32)
+        + 3 * h * w * c * 2        # y, optional z, stats/slack
+    )
+    return max(1, min(32, _VMEM_BUDGET_BYTES // per_img))
 
 
 def _affine_act(x, scale, shift, res, activate):
@@ -170,6 +193,8 @@ def _run_local(x, w, scale, shift, residual, block_b, activate,
     if w.shape != (3, 3, c, c):
         raise ValueError(f"square 3x3 conv only, got weight {w.shape} "
                          f"for input channels {c}")
+    if not block_b:
+        block_b = min(b, _auto_block_b(h, wd, c))
     xp = _pad_batch(x, block_b)
     # Wcat[(dh, c_in), (dw, c_out)] = w[dh, dw, c_in, c_out]: K rows match
     # the kernel's dh-concat of input slices, N columns put all three dw
