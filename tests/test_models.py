@@ -335,3 +335,41 @@ class TestFusedResNet:
         assert int(state.step) == 1
         assert np.isfinite(float(m["loss"])) and float(m["loss"]) > 0
         assert int(m["count"]) == 16
+
+    @pytest.mark.slow
+    def test_fused_training_trajectory_tracks_unfused(self, mesh1):
+        """24 optimizer steps, same data order: the fused-all model's loss
+        trajectory must track the unfused one closely at every step — a
+        slow-bias bug (e.g. subtly wrong kernel-emitted stat normalization)
+        would compound here while staying invisible to single-step tests."""
+        from tpu_dp.data.cifar import make_synthetic, normalize
+        from tpu_dp.train import (
+            SGD, constant_lr, create_train_state, make_train_step,
+        )
+
+        opt = SGD(momentum=0.9)
+        ds = make_synthetic(256, 10, seed=0, name="traj")
+        imgs = normalize(ds.images)
+        labels = ds.labels
+        x0 = np.zeros((1, 32, 32, 3), np.float32)
+
+        def run(fused):
+            kw = dict(num_classes=10, num_filters=16, dtype=jnp.bfloat16)
+            if fused:
+                kw.update(fused_stages=(0, 1, 2, 3))
+            m = build_model("resnet18", **kw)
+            s = create_train_state(m, jax.random.PRNGKey(0), x0, opt)
+            step = make_train_step(m, opt, mesh1, constant_lr(0.05))
+            losses = []
+            for i in range(24):
+                lo = (i * 32) % 256
+                s, met = step(s, {"image": imgs[lo:lo + 32],
+                                  "label": labels[lo:lo + 32]})
+                losses.append(float(met["loss"]))
+            return losses
+
+        l0 = run(False)
+        l1 = run(True)
+        assert l0[-1] < 0.5 and l1[-1] < 0.5  # both actually converge
+        for i, (a, b) in enumerate(zip(l0, l1)):
+            assert abs(a - b) < 0.05, f"step {i}: {a} vs {b}"
