@@ -10,12 +10,18 @@ ring allreduce fallback for host coordination off-TPU — are native C++
 """
 
 from tpu_dp.ops import native
-from tpu_dp.ops.conv_block import fused_affine_relu_conv
+from tpu_dp.ops.conv_block import (
+    fused_affine_relu_conv,
+    fused_affine_relu_conv_emit,
+    fused_conv_bn,
+)
 from tpu_dp.ops.xent import mean_softmax_xent, softmax_xent
 
 __all__ = [
     "native",
     "fused_affine_relu_conv",
+    "fused_affine_relu_conv_emit",
+    "fused_conv_bn",
     "mean_softmax_xent",
     "softmax_xent",
 ]
