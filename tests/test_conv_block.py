@@ -290,3 +290,32 @@ def test_rectangular_spatial():
         np.asarray(y, np.float32), np.asarray(yr, np.float32),
         rtol=0, atol=1e-2,
     )
+
+
+def test_auto_block_b_accounts_for_variant_blocks():
+    # ADVICE r3: the VMEM working-set model must include the residual
+    # input block and the emitted-z output block, so variant grids can
+    # only shrink (never exceed the budget the plain kernel was sized to).
+    from tpu_dp.ops.conv_block import _auto_block_b
+
+    plain = _auto_block_b(32, 32, 64)
+    res = _auto_block_b(32, 32, 64, with_res=True)
+    emit = _auto_block_b(32, 32, 64, emit_z=True)
+    both = _auto_block_b(32, 32, 64, with_res=True, emit_z=True)
+    assert plain >= res >= both >= 1
+    assert plain >= emit >= both
+
+
+def test_fused_bottleneck_rejects_non_relu_act():
+    # ADVICE r3: the fused middle conv bakes ReLU into the kernel; a
+    # different `act` must fail loudly, not apply only at the block exit.
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from tpu_dp.models.resnet import FusedBottleneckBlock
+
+    blk = FusedBottleneckBlock(filters=8, act=nn.gelu)
+    with pytest.raises(ValueError, match="ReLU"):
+        blk.init(jax.random.PRNGKey(0), jnp.zeros((2, 8, 8, 32)))

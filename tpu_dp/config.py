@@ -24,7 +24,10 @@ class ModelConfig:
     # Pallas fused-conv stages for ResNet blocks (BasicBlock chains,
     # Bottleneck middle-3x3s): "" (off), "all",
     # or comma-separated stage indices, e.g. "0" = stage 1 only
-    # (tpu_dp/ops/conv_block.py; checkpoint-compatible with the unfused model)
+    # (tpu_dp/ops/conv_block.py; checkpoint-compatible with the unfused model).
+    # Note: fused activations round through bfloat16 inside the kernel, so
+    # with bf16=false a fused model computes slightly below full-f32
+    # precision (fused/unfused chains stay mutually consistent either way).
     fused_stages: str = ""
     fused_block_b: int = 0  # images per Pallas grid step; 0 = auto from VMEM budget
     fused_bwd: bool = False  # route the backward input-grad conv through it too

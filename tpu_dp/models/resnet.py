@@ -207,6 +207,13 @@ class FusedBottleneckBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        if self.act is not nn.relu:
+            # The fused middle conv and _materialize bake ReLU into the
+            # kernel's transform; honoring a different act only at the
+            # block exit would be silently inconsistent.
+            raise ValueError("FusedBottleneckBlock fuses ReLU; act must be "
+                             "nn.relu (use the unfused BottleneckBlock for "
+                             "other activations)")
         residual = x
         y = self.conv(self.filters, (1, 1), name="Conv_0")(x)
         s1, b1 = self.norm_coeffs(name="BatchNorm_0")(y)

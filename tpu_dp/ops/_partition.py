@@ -11,9 +11,13 @@ code falls back to the op's identical XLA statement there).
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding
+
+logger = logging.getLogger(__name__)
 
 
 def interpret() -> bool:
@@ -28,10 +32,22 @@ def shard_map_interp(x) -> bool:
 
 def batch_axis(arg_infos):
     """The mesh-axis resource operand 0's leading (batch) dim is sharded
-    over, or None."""
+    over, or None.
+
+    The partition rules built on this shard only the batch dim; when
+    operand 0 arrives sharded on some *other* dim (batch unsharded), the
+    rule forces full replication and GSPMD inserts an all-gather on the
+    hot path — legal but almost certainly not what the caller meant, so
+    it is logged rather than silent (compile-time only, once per trace).
+    """
     sh = arg_infos[0].sharding
     if sh is None or not isinstance(sh, NamedSharding) or not len(sh.spec):
         return None
+    if sh.spec[0] is None and any(ax is not None for ax in sh.spec[1:]):
+        logger.warning(
+            "Pallas op partition: operand 0 is sharded on a non-batch dim "
+            "(spec %s); the batch-only partition rule will replicate it "
+            "(all-gather inserted on the hot path)", sh.spec)
     return sh.spec[0]
 
 

@@ -76,20 +76,28 @@ def _pad128(n: int) -> int:
     return -(-n // 128) * 128
 
 
-def _auto_block_b(h: int, w: int, c: int) -> int:
+def _auto_block_b(h: int, w: int, c: int, with_res: bool = False,
+                  emit_z: bool = False) -> int:
     """Images per grid step that keep the kernel's working set under the
     VMEM budget: per image the kernel holds x, zp, the dh-concat win, the
-    f32 matmul output t (lanes padded to 128), the f32 acc slice, and the
-    y (+z) outputs — stage-1 shapes (~2.5 MB/image at 32x32x64) fit 4,
-    later stages progressively more."""
+    f32 matmul output t (lanes padded to 128), the f32 acc slice, the y
+    output plus slack, and — per variant — the residual input block and
+    the emitted-z output block.  Stage-1 shapes (~2.5 MB/image at
+    32x32x64) fit 4; later stages progressively more.  Each `_run_local`
+    call sizes itself (forward and backward invoke this separately with
+    their own variant flags), so a backward pass never inherits a
+    forward-tuned value unless the caller pinned block_b explicitly."""
     wp = w + 2
+    img = h * w * c * 2            # one [block,h,w,c] bf16 block
     per_img = (
-        h * w * c * 2              # x block
+        img                        # x block
         + (h + 2) * wp * c * 2     # zp
         + h * wp * 3 * c * 2       # win
         + h * wp * _pad128(3 * c) * 4   # t (f32)
         + h * wp * _pad128(c) * 4       # acc (f32)
-        + 3 * h * w * c * 2        # y, optional z, stats/slack
+        + 3 * img                  # y output + slack (stats tile is tiny)
+        + (img if with_res else 0)     # residual input block
+        + (img if emit_z else 0)       # emitted z output block
     )
     return max(1, min(32, _VMEM_BUDGET_BYTES // per_img))
 
@@ -194,7 +202,8 @@ def _run_local(x, w, scale, shift, residual, block_b, activate,
         raise ValueError(f"square 3x3 conv only, got weight {w.shape} "
                          f"for input channels {c}")
     if not block_b:
-        block_b = min(b, _auto_block_b(h, wd, c))
+        block_b = min(b, _auto_block_b(h, wd, c, with_res=residual is not None,
+                                       emit_z=emit_z))
     xp = _pad_batch(x, block_b)
     # Wcat[(dh, c_in), (dw, c_out)] = w[dh, dw, c_in, c_out]: K rows match
     # the kernel's dh-concat of input slices, N columns put all three dw
