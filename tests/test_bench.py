@@ -214,3 +214,43 @@ def test_run_point_timeout(monkeypatch):
     )
     rec = bench.run_point({}, timeout_s=7)
     assert rec["value"] is None and "timeout" in rec["error"]
+
+
+def test_archive_tags_cpu_backend_as_smoke(tmp_path, monkeypatch):
+    # CPU-backend rows are outage-time harness smoke tests under a TPU
+    # metric name; archive() must tag them so archive consumers don't need
+    # to know the backend convention (docs/DESIGN.md "Benchmarking
+    # honestly"). Accelerator rows must stay untagged.
+    p = tmp_path / "results.jsonl"
+    monkeypatch.setattr(bench, "RESULTS_PATH", p)
+    bench.archive({"value": 9.9, "backend": "cpu"})
+    bench.archive({"value": 34000.0, "backend": "tpu"})
+    rows = [json.loads(x) for x in p.read_text().splitlines()]
+    assert rows[0]["smoke"] is True
+    assert "smoke" not in rows[1]
+
+
+def test_fused_sweep_grid_covers_both_windows(monkeypatch, capsys):
+    # The fused-variant verdict must include the headline operating point
+    # (w30 scanned windows), not just the w1 dispatch-bound comparison
+    # (VERDICT r3 weak #4): 5 variants x 2 windows.
+    grids = []
+
+    def fake_run_point(cfg, timeout_s):
+        grids.append(cfg)
+        return {"value": 1.0, "unit": bench.UNIT, "vs_baseline": 0.0,
+                "metric": bench.METRIC, "config": cfg}
+
+    monkeypatch.setattr(bench, "probe_device", lambda *a, **k: (
+        {"n_devices": 1, "device_kind": "x", "backend": "tpu"}, None))
+    monkeypatch.setattr(bench, "run_point", fake_run_point)
+    monkeypatch.setattr(bench, "archive", lambda r: None)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py", "--sweep-fused"])
+    bench.main()
+    capsys.readouterr()  # swallow the emitted headline line
+    pts = {(g["fused_stages"], g["fused_bwd"], g["steps_per_call"])
+           for g in grids}
+    variants = {("", False), ("0", False), ("all", False),
+                ("0", True), ("all", True)}
+    assert pts == {(fs, fb, w) for fs, fb in variants for w in (1, 30)}
+    assert len(grids) == 10
