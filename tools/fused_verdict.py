@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Render the fused-conv verdict from captured TPU measurements.
+
+Reads the e2e sweep rows in `benchmarks/results.jsonl` (non-smoke,
+accelerator-backend) and the kernel microbench JSON lines under
+`benchmarks/r4_capture/fusedk_*.out`, and prints:
+
+  1. a per-(batch, window) e2e table: unfused vs each fused variant,
+  2. a per-stage-shape kernel table: XLA vs Pallas per block_b,
+  3. the verdict line VERDICT r3 item 1 asks for — which variant (if any)
+     beats unfused at the headline operating point, with the margin.
+
+Pure file parsing (no device); run any time:
+    python tools/fused_verdict.py
+    python tools/fused_verdict.py --model resnet50
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+from collections import defaultdict
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+RESULTS = ROOT / "benchmarks" / "results.jsonl"
+CAPTURE = ROOT / "benchmarks" / "r4_capture"
+
+
+def load_results(metric_substr: str):
+    rows = []
+    try:
+        lines = RESULTS.read_text().splitlines()
+    except OSError:
+        return rows
+    for line in lines:
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if (r.get("value") and not r.get("smoke")
+                and r.get("backend") not in (None, "cpu")
+                and metric_substr in r.get("metric", "")):
+            rows.append(r)
+    return rows
+
+
+def variant_key(cfg: dict) -> str:
+    fs = cfg.get("fused_stages") or ""
+    if not fs:
+        return "unfused"
+    return f"fused[{fs}]" + ("+bwd" if cfg.get("fused_bwd") else "")
+
+
+def e2e_table(rows):
+    # newest row wins per (batch, window, variant)
+    cells: dict = {}
+    for r in sorted(rows, key=lambda r: r.get("ts", "")):
+        cfg = r.get("config") or {}
+        if cfg.get("xent") == "pallas":
+            continue  # fused sweeps run jnp xent; keep cells like-for-like
+        key = (cfg.get("per_chip_batch"), cfg.get("steps_per_call"),
+               variant_key(cfg))
+        cells[key] = r
+    variants = sorted({k[2] for k in cells}, key=lambda v: (v != "unfused", v))
+    points = sorted({(k[0], k[1]) for k in cells},
+                    key=lambda p: (p[0] or 0, p[1] or 0))
+    if not points:
+        return None, variants, cells
+    head = "| batch/chip | window | " + " | ".join(variants) + " |"
+    sep = "|---" * (len(variants) + 2) + "|"
+    lines = [head, sep]
+    for b, w in points:
+        row = [f"| {b} | {w} "]
+        base = cells.get((b, w, "unfused"))
+        for v in variants:
+            r = cells.get((b, w, v))
+            if r is None:
+                row.append("| — ")
+                continue
+            val = f"{r['value']:,.0f}"
+            if r.get("mfu") is not None:
+                val += f" (.{round(r['mfu'] * 1000):03d})"
+            if base and v != "unfused":
+                val += f" {100 * (r['value'] / base['value'] - 1):+.1f}%"
+            row.append(f"| {val} ")
+        lines.append("".join(row) + "|")
+    return "\n".join(lines), variants, cells
+
+
+def kernel_table():
+    recs = []
+    for path in sorted(glob.glob(str(CAPTURE / "fusedk_*.out"))):
+        for line in Path(path).read_text().splitlines():
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if r.get("device") and r.get("device") != "cpu" and r.get("ms"):
+                recs.append(r)
+    if not recs:
+        return None
+    by_point = defaultdict(list)
+    for r in recs:
+        by_point[(tuple(r["shape"]), bool(r.get("grad")),
+                  bool(r.get("residual")))].append(r)
+    lines = ["| shape | mode | xla ms (%pk) | best pallas ms (%pk) | "
+             "block_b | speedup |", "|---|---|---|---|---|---|"]
+    for (shape, grad, res), rs in sorted(by_point.items()):
+        xla = [r for r in rs if r["impl"] == "xla"]
+        pal = [r for r in rs if r["impl"].startswith("pallas")]
+        if not xla or not pal:
+            continue
+        x = min(xla, key=lambda r: r["ms"])
+        p = min(pal, key=lambda r: r["ms"])
+        mode = ("fwd+bwd" if grad else "fwd") + ("+res" if res else "")
+        lines.append(
+            f"| {'x'.join(map(str, shape))} | {mode} "
+            f"| {x['ms']} ({x.get('pct_peak')}) "
+            f"| {p['ms']} ({p.get('pct_peak')}) [{p['impl']}] "
+            f"| {p['block_b']} | {x['ms'] / p['ms']:.2f}x |")
+    return "\n".join(lines) if len(lines) > 2 else None
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18")
+    ap.add_argument("--headline-batch", type=int, default=2048)
+    ap.add_argument("--headline-window", type=int, default=30)
+    args = ap.parse_args()
+
+    rows = load_results(args.model)
+    table, variants, cells = e2e_table(rows)
+    print(f"# Fused-conv verdict ({args.model})\n")
+    if table is None:
+        print("No accelerator e2e rows yet — run `python bench.py "
+              "--sweep-fused` on the chip (or wait for the r4 watcher).")
+    else:
+        print("## End-to-end (images/sec/chip, (MFU), % vs unfused)\n")
+        print(table)
+
+    kt = kernel_table()
+    if kt:
+        print("\n## Kernel microbench (best per shape)\n")
+        print(kt)
+    else:
+        print("\n(no TPU kernel microbench captures under "
+              "benchmarks/r4_capture/ yet)")
+
+    # The verdict line.
+    hb, hw = args.headline_batch, args.headline_window
+    base = cells.get((hb, hw, "unfused")) if cells else None
+    fused = [(v, cells[(hb, hw, v)]) for v in variants
+             if v != "unfused" and (hb, hw, v) in cells] if cells else []
+    print()
+    if base and fused:
+        best_v, best = max(fused, key=lambda kv: kv[1]["value"])
+        margin = 100 * (best["value"] / base["value"] - 1)
+        if margin > 0:
+            print(f"VERDICT: {best_v} BEATS unfused at the headline point "
+                  f"(b{hb}/w{hw}): {best['value']:,.0f} vs "
+                  f"{base['value']:,.0f} img/s/chip ({margin:+.1f}%) — make "
+                  f"it the headline config.")
+        else:
+            print(f"VERDICT: no fused variant beats unfused at the headline "
+                  f"point (b{hb}/w{hw}); best is {best_v} at {margin:+.1f}% "
+                  f"({best['value']:,.0f} vs {base['value']:,.0f}) — keep "
+                  f"fused_stages default off, document as the Pallas "
+                  f"exemplar.")
+    else:
+        print("VERDICT: pending — headline-point measurements for both "
+              "unfused and fused variants not yet captured.")
+
+
+if __name__ == "__main__":
+    main()

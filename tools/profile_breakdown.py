@@ -9,6 +9,8 @@ and aggregates device time by HLO category plus a per-op efficiency table
 
     python tools/profile_breakdown.py                  # b2048, w30 (headline)
     python tools/profile_breakdown.py --per-chip-batch 1024 --window 30
+    python tools/profile_breakdown.py --model resnet50 --per-chip-batch 1024
+    python tools/profile_breakdown.py --fused-stages all   # fused Pallas path
 
 Parsing notes (this environment): the Perfetto trace.json.gz export carries
 host lanes only on this relay transport — the device lanes live in the
@@ -34,25 +36,39 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
 V5E_PEAK_TFLOPS = 197.0
 V5E_PEAK_HBM_GBS = 819.0
 
+# One source of truth for model -> num_classes: bench.py's MODEL_SPECS
+# (BASELINE.json config 3 runs ResNet-50 on CIFAR-100).
+from bench import MODEL_SPECS  # noqa: E402  (repo root on sys.path above)
 
-def capture(trace_dir: str, per_chip: int, window: int) -> None:
+MODEL_CLASSES = {name: spec[1] for name, spec in MODEL_SPECS.items()}
+
+
+def capture(trace_dir: str, per_chip: int, window: int, model_name: str,
+            fused_stages: str, fused_block_b: int, fused_bwd: bool,
+            platform: str | None = None) -> None:
     import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 
     from tpu_dp.data.cifar import make_synthetic
-    from tpu_dp.models import ResNet18
+    from tpu_dp.models import build_model, parse_fused_stages
     from tpu_dp.parallel import dist
     from tpu_dp.parallel.sharding import scan_batch_sharding, shard_batch
     from tpu_dp.train import SGD, cosine_lr, create_train_state, make_multi_step
 
     mesh = dist.data_mesh()
     gb = per_chip * int(mesh.devices.size)
-    model = ResNet18(num_classes=10, dtype=jnp.bfloat16)
+    nc = MODEL_CLASSES[model_name]
+    model = build_model(model_name, num_classes=nc, dtype=jnp.bfloat16,
+                        fused_stages=parse_fused_stages(fused_stages),
+                        fused_block_b=fused_block_b, fused_bwd=fused_bwd)
     opt = SGD(momentum=0.9, weight_decay=5e-4)
     state = create_train_state(model, jax.random.PRNGKey(0),
                                np.zeros((1, 32, 32, 3), np.float32), opt)
-    pool_host = [make_synthetic(gb, 10, seed=i, name="bench") for i in range(4)]
+    pool_host = [make_synthetic(gb, nc, seed=i, name="bench") for i in range(4)]
     stacked = {"image": np.stack([d.images for d in pool_host]),
                "label": np.stack([d.labels for d in pool_host])}
     pool = shard_batch(stacked, mesh, spec=scan_batch_sharding(mesh))
@@ -131,6 +147,16 @@ def report(trace_dir: str, top: int) -> None:
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="resnet18", choices=sorted(MODEL_CLASSES))
+    ap.add_argument("--platform", default=None, choices=["cpu"],
+                    help="force cpu (harness smoke test; the env's "
+                         "sitecustomize pins the tpu backend, so the env "
+                         "var alone is not enough)")
+    ap.add_argument("--fused-stages", default="",
+                    help="ResNet stages on the fused Pallas conv path "
+                         "('', '0', 'all'; tpu_dp/ops/conv_block.py)")
+    ap.add_argument("--fused-block-b", type=int, default=0)
+    ap.add_argument("--fused-bwd", action="store_true")
     ap.add_argument("--per-chip-batch", type=int, default=2048)
     ap.add_argument("--window", type=int, default=30)
     ap.add_argument("--trace-dir", default=None,
@@ -147,7 +173,9 @@ def main() -> None:
 
     trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="tpu_dp_trace_")
     if not args.report_only:
-        capture(trace_dir, args.per_chip_batch, args.window)
+        capture(trace_dir, args.per_chip_batch, args.window, args.model,
+                args.fused_stages, args.fused_block_b, args.fused_bwd,
+                platform=args.platform)
     report(trace_dir, args.top)
     print(f"\ntrace kept at {trace_dir}")
 
