@@ -1,0 +1,66 @@
+#!/bin/bash
+# Round-4 TPU capture watcher.
+#
+# The box reaches its one TPU v5e chip through a relay that wedges for
+# hours and comes back in windows sometimes only minutes long (see
+# benchmarks/longrun_r3/README.md).  This watcher turns that into
+# captured measurements: it probes the chip with a tiny matmul in a
+# timeout-wrapped subprocess, and the moment a probe succeeds it runs the
+# queued measurement stages in priority order, each under its own
+# timeout, checkpointing completion per stage so an interrupted window
+# resumes where it left off.
+#
+# Stages live in benchmarks/r4_capture/stages.txt, one per line:
+#   name|timeout_seconds|command...
+# The file is re-read every loop, so new stages can be appended while the
+# watcher runs.  A stage is skipped once benchmarks/r4_capture/<name>.done
+# exists; stdout/stderr land in <name>.out / <name>.err.
+#
+# Usage:  bash tools/r4_watch.sh   (run in background; tail watch.log)
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/r4_capture
+mkdir -p "$OUT"
+STAGES="$OUT/stages.txt"
+
+log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
+
+probe() {
+  timeout -k 10 90 python - >/dev/null 2>&1 <<'EOF'
+import jax, jax.numpy as jnp
+x = jnp.ones((128, 128), jnp.bfloat16)
+assert float((x @ x).sum()) > 0
+EOF
+}
+
+log "watcher started (pid $$)"
+while :; do
+  if probe; then
+    log "probe ok"
+    ran_any=0
+    while IFS='|' read -r name to cmd; do
+      [ -z "${name:-}" ] && continue
+      case "$name" in \#*) continue ;; esac
+      [ -f "$OUT/$name.done" ] && continue
+      ran_any=1
+      log "stage $name: starting (timeout ${to}s): $cmd"
+      if timeout -k 30 "$to" bash -c "$cmd" >"$OUT/$name.out" 2>"$OUT/$name.err"; then
+        touch "$OUT/$name.done"
+        log "stage $name: DONE"
+      else
+        rc=$?
+        log "stage $name: FAILED rc=$rc — re-probing before next stage"
+        break   # relay may have wedged mid-stage; fall back to probing
+      fi
+    done < "$STAGES"
+    if [ "$ran_any" = 0 ]; then
+      log "all stages done; idling (append to stages.txt to add work)"
+      sleep 600
+      continue
+    fi
+  else
+    log "probe failed (relay down)"
+  fi
+  sleep 120
+done
