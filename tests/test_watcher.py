@@ -1,0 +1,118 @@
+"""The outage-capture watcher (`tools/r4_watch.sh`) drains its stage queue
+correctly: priority order, per-stage .done checkpoints, failed stages
+retried a bounded number of times without blocking the queue behind them.
+
+The watcher exists because the TPU relay comes back in windows sometimes
+minutes long (benchmarks/longrun_r3/README.md); these tests drive it with
+the R4_* env hooks (fake probe, tmp capture dir, fast sleeps) — no TPU,
+no jax.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+WATCH = REPO / "tools" / "r4_watch.sh"
+
+
+def _run_watcher(cap: Path, probe_cmd: str, until, timeout_s: float = 25.0):
+    env = dict(os.environ, R4_CAPTURE_DIR=str(cap),
+               R4_PROBE_CMD=probe_cmd, R4_SLEEP_S="1")
+    p = subprocess.Popen(["bash", str(WATCH)], env=env, cwd=str(REPO),
+                        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if until():
+                return
+            time.sleep(0.25)
+        pytest.fail(
+            f"watcher did not reach expected state in {timeout_s}s; log:\n"
+            + (cap / "watch.log").read_text())
+    finally:
+        p.kill()
+        p.wait()
+
+
+def test_stages_run_in_order_and_checkpoint(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    (cap / "stages.txt").write_text(
+        "# comment line\n"
+        f"first|30|echo one >> {cap}/order\n"
+        f"second|30|echo two >> {cap}/order\n"
+    )
+    def done_and_idled_again():
+        # Wait past completion until the watcher has gone around the loop
+        # at least twice more (logged probes), so the no-re-run assertion
+        # below is made against a watcher that had the chance to re-run.
+        if not (cap / "second.done").exists():
+            return False
+        log = (cap / "watch.log").read_text()
+        return log.count("probe ok") + log.count("no runnable stages") >= 3
+
+    _run_watcher(cap, "true", done_and_idled_again)
+    assert (cap / "first.done").exists()
+    # .done checkpoints held: the later loops did not re-run the stages
+    # (the order file would have grown).
+    assert (cap / "order").read_text().splitlines() == ["one", "two"]
+
+
+def test_failing_stage_does_not_block_queue_and_is_bounded(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    (cap / "stages.txt").write_text(
+        "bad|30|false\n"
+        f"after|30|echo ran >> {cap}/proof\n"
+    )
+    # Probe stays up, so 'bad' is a genuine stage failure: the watcher
+    # must move past it to 'after' in the same window.
+    _run_watcher(cap, "true", lambda: (cap / "after.done").exists())
+    assert (cap / "proof").read_text().splitlines() == ["ran"]
+    assert not (cap / "bad.done").exists()
+    assert int((cap / "bad.fail").read_text()) >= 1
+
+    # Retries are bounded at 3: run until the fail counter saturates.
+    _run_watcher(cap, "true",
+                 lambda: (cap / "bad.fail").exists()
+                 and int((cap / "bad.fail").read_text()) >= 3)
+    assert int((cap / "bad.fail").read_text()) == 3
+
+
+def test_wedge_kill_does_not_count_toward_attempt_bound(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    # The stage simulates the relay wedging mid-run: it drops the
+    # relay_down marker (failing the post-failure probe) and dies. Such
+    # kills must NOT consume one of the 3 attempts — the stage is retried
+    # at the next window instead (VERDICT: the long stages the watcher
+    # exists for are exactly the ones a short window kills).
+    (cap / "stages.txt").write_text(
+        f"wedged|30|touch {cap}/relay_down && false\n"
+        f"after|30|echo ran >> {cap}/proof\n"
+    )
+    _run_watcher(cap, f"test ! -f {cap}/relay_down",
+                 lambda: "relay down — back to probing" in
+                 ((cap / "watch.log").read_text()
+                  if (cap / "watch.log").exists() else ""))
+    assert not (cap / "wedged.fail").exists()
+    assert not (cap / "after.done").exists()  # queue falls back to probing
+
+
+def test_no_probe_no_stages(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    (cap / "stages.txt").write_text(f"only|30|echo x >> {cap}/proof\n")
+    # Probe always fails (relay down): no stage may run.
+    _run_watcher(cap, "false",
+                 lambda: "probe failed" in
+                 ((cap / "watch.log").read_text()
+                  if (cap / "watch.log").exists() else ""))
+    assert not (cap / "proof").exists()
+    assert not (cap / "only.done").exists()

@@ -17,16 +17,25 @@
 # exists; stdout/stderr land in <name>.out / <name>.err.
 #
 # Usage:  bash tools/r4_watch.sh   (run in background; tail watch.log)
+#
+# Test hooks (tests/test_watcher.py): R4_CAPTURE_DIR overrides the
+# capture dir, R4_PROBE_CMD replaces the TPU probe, R4_SLEEP_S the
+# inter-probe sleep.
 
 set -u
 cd "$(dirname "$0")/.."
-OUT=benchmarks/r4_capture
+OUT="${R4_CAPTURE_DIR:-benchmarks/r4_capture}"
 mkdir -p "$OUT"
 STAGES="$OUT/stages.txt"
+SLEEP_S="${R4_SLEEP_S:-120}"
 
 log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
 
 probe() {
+  if [ -n "${R4_PROBE_CMD:-}" ]; then
+    timeout -k 10 90 bash -c "$R4_PROBE_CMD" >/dev/null 2>&1
+    return
+  fi
   timeout -k 10 90 python - >/dev/null 2>&1 <<'EOF'
 import jax, jax.numpy as jnp
 x = jnp.ones((128, 128), jnp.bfloat16)
@@ -39,28 +48,40 @@ while :; do
   if probe; then
     log "probe ok"
     ran_any=0
-    while IFS='|' read -r name to cmd; do
+    while IFS='|' read -r name to cmd || [ -n "${name:-}" ]; do
       [ -z "${name:-}" ] && continue
       case "$name" in \#*) continue ;; esac
       [ -f "$OUT/$name.done" ] && continue
+      attempts=$(cat "$OUT/$name.fail" 2>/dev/null || echo 0)
+      [ "$attempts" -ge 3 ] && continue   # perma-failed; stop burning windows
       ran_any=1
-      log "stage $name: starting (timeout ${to}s): $cmd"
+      log "stage $name: starting (timeout ${to}s, attempt $((attempts + 1))/3): $cmd"
       if timeout -k 30 "$to" bash -c "$cmd" >"$OUT/$name.out" 2>"$OUT/$name.err"; then
         touch "$OUT/$name.done"
         log "stage $name: DONE"
       else
         rc=$?
-        log "stage $name: FAILED rc=$rc — re-probing before next stage"
-        break   # relay may have wedged mid-stage; fall back to probing
+        # A stage can fail because the relay wedged mid-run (re-probe
+        # fails: fall back to the outer probe loop, retry the stage next
+        # window — wedge kills do NOT count toward the attempt bound) or
+        # on its own bug (relay still up: count the attempt and move on
+        # so one bad stage can't block the queue behind it).
+        if probe; then
+          echo $((attempts + 1)) > "$OUT/$name.fail"
+          log "stage $name: FAILED rc=$rc, relay up (attempt $((attempts + 1))/3) — continuing to next stage"
+        else
+          log "stage $name: FAILED rc=$rc, relay down — back to probing"
+          break
+        fi
       fi
     done < "$STAGES"
     if [ "$ran_any" = 0 ]; then
-      log "all stages done; idling (append to stages.txt to add work)"
-      sleep 600
+      log "no runnable stages (all done or perma-failed); idling"
+      sleep $((SLEEP_S * 5))
       continue
     fi
   else
     log "probe failed (relay down)"
   fi
-  sleep 120
+  sleep "$SLEEP_S"
 done
