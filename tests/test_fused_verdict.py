@@ -38,7 +38,10 @@ def test_smoke_cpu_and_pallas_xent_rows_excluded(monkeypatch, tmp_path,
                                                  capsys):
     _write(monkeypatch, tmp_path, [
         _row(34000, "2026-07-30T01:00:00Z"),
-        _row(9.9, "2026-07-30T02:00:00Z", backend="cpu", smoke=True),
+        # smoke on tpu backend and plain cpu backend pin the two filters
+        # independently (a smoke row is not necessarily backend=cpu).
+        _row(9.9, "2026-07-30T02:00:00Z", smoke=True),
+        _row(7.7, "2026-07-30T02:30:00Z", backend="cpu"),
         _row(50000, "2026-07-30T03:00:00Z", xent="pallas"),
     ])
     monkeypatch.setattr(sys, "argv", ["fused_verdict.py"])
@@ -46,7 +49,8 @@ def test_smoke_cpu_and_pallas_xent_rows_excluded(monkeypatch, tmp_path,
     line, out = _verdict_line(capsys)
     # The unfused cell must be the tpu/jnp row — not the newer pallas-xent
     # row, not the smoke row.
-    assert "34,000" in out and "50,000" not in out and "9.9" not in out
+    assert "34,000" in out and "50,000" not in out
+    assert "9.9" not in out and "7.7" not in out
 
 
 def test_winning_variant_flips_the_verdict(monkeypatch, tmp_path, capsys):

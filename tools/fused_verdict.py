@@ -91,7 +91,15 @@ def e2e_table(rows):
 
 def kernel_table():
     recs = []
-    for path in sorted(glob.glob(str(CAPTURE / "fusedk_*.out"))):
+    # captured/ holds the watcher-preserved (committed) copies; the top
+    # level holds this session's live outputs — read both, dedup by path
+    # basename preferring the live copy.
+    paths = {Path(p).name: p
+             for p in sorted(glob.glob(str(CAPTURE / "captured"
+                                           / "fusedk_*.out")))}
+    paths.update({Path(p).name: p
+                  for p in sorted(glob.glob(str(CAPTURE / "fusedk_*.out")))})
+    for path in sorted(paths.values()):
         for line in Path(path).read_text().splitlines():
             try:
                 r = json.loads(line)

@@ -51,13 +51,28 @@ while :; do
     while IFS='|' read -r name to cmd || [ -n "${name:-}" ]; do
       [ -z "${name:-}" ] && continue
       case "$name" in \#*) continue ;; esac
-      [ -f "$OUT/$name.done" ] && continue
+      if [ -f "$OUT/$name.done" ]; then
+        # Backfill: stages completed before the captured/ mirror existed
+        # (or whose copy failed) still get preserved.
+        if [ -f "$OUT/$name.out" ] && [ ! -f "$OUT/captured/$name.out" ]; then
+          mkdir -p "$OUT/captured"
+          cp "$OUT/$name.out" "$OUT/captured/$name.out" \
+            || log "stage $name: mirror failed"
+        fi
+        continue
+      fi
       attempts=$(cat "$OUT/$name.fail" 2>/dev/null || echo 0)
       [ "$attempts" -ge 3 ] && continue   # perma-failed; stop burning windows
       ran_any=1
       log "stage $name: starting (timeout ${to}s, attempt $((attempts + 1))/3): $cmd"
       if timeout -k 30 "$to" bash -c "$cmd" >"$OUT/$name.out" 2>"$OUT/$name.err"; then
         touch "$OUT/$name.done"
+        # Mirror successful outputs into the tracked captured/ dir so an
+        # end-of-session auto-commit preserves them even if no one is
+        # around when the window opens.
+        mkdir -p "$OUT/captured"
+        cp "$OUT/$name.out" "$OUT/captured/$name.out" \
+          || log "stage $name: mirror failed"
         log "stage $name: DONE"
       else
         rc=$?
