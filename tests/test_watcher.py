@@ -11,6 +11,7 @@ no jax.
 from __future__ import annotations
 
 import os
+import signal
 import subprocess
 import time
 from pathlib import Path
@@ -21,11 +22,28 @@ REPO = Path(__file__).resolve().parent.parent
 WATCH = REPO / "tools" / "r4_watch.sh"
 
 
-def _run_watcher(cap: Path, probe_cmd: str, until, timeout_s: float = 25.0):
+def _spawn(cap: Path, probe_cmd: str):
     env = dict(os.environ, R4_CAPTURE_DIR=str(cap),
                R4_PROBE_CMD=probe_cmd, R4_SLEEP_S="1")
-    p = subprocess.Popen(["bash", str(WATCH)], env=env, cwd=str(REPO),
-                        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    # Own process group: teardown must kill the watcher's children too
+    # (a surviving `sleep` would briefly hold the flock fd it inherited
+    # and block the next watcher instance the test starts).
+    return subprocess.Popen(["bash", str(WATCH)], env=env, cwd=str(REPO),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL,
+                            preexec_fn=os.setsid)
+
+
+def _killpg(p):
+    try:
+        os.killpg(p.pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    p.wait()
+
+
+def _run_watcher(cap: Path, probe_cmd: str, until, timeout_s: float = 25.0):
+    p = _spawn(cap, probe_cmd)
     try:
         deadline = time.time() + timeout_s
         while time.time() < deadline:
@@ -36,8 +54,7 @@ def _run_watcher(cap: Path, probe_cmd: str, until, timeout_s: float = 25.0):
             f"watcher did not reach expected state in {timeout_s}s; log:\n"
             + (cap / "watch.log").read_text())
     finally:
-        p.kill()
-        p.wait()
+        _killpg(p)
 
 
 def test_stages_run_in_order_and_checkpoint(tmp_path):
@@ -116,3 +133,77 @@ def test_no_probe_no_stages(tmp_path):
                   if (cap / "watch.log").exists() else ""))
     assert not (cap / "proof").exists()
     assert not (cap / "only.done").exists()
+
+
+def test_second_watcher_instance_exits(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    (cap / "stages.txt").write_text("")
+    p1 = _spawn(cap, "false")
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            log = ((cap / "watch.log").read_text()
+                   if (cap / "watch.log").exists() else "")
+            if "watcher started" in log:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("first watcher never logged startup in 10s")
+        # Second instance must yield the capture dir and exit promptly.
+        env = dict(os.environ, R4_CAPTURE_DIR=str(cap),
+                   R4_PROBE_CMD="false", R4_SLEEP_S="1")
+        p2 = subprocess.run(["bash", str(WATCH)], env=env, cwd=str(REPO),
+                            timeout=10)
+        assert p2.returncode == 0
+        assert "another watcher holds" in (cap / "watch.log").read_text()
+        assert p1.poll() is None  # first instance unaffected
+    finally:
+        _killpg(p1)
+
+
+def test_pause_file_idles_watcher(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    (cap / "pause").touch()
+    (cap / "stages.txt").write_text(f"only|30|echo x >> {cap}/proof\n")
+    p = _spawn(cap, "true")
+    try:
+        time.sleep(3)
+        assert not (cap / "proof").exists()  # paused: nothing ran
+        (cap / "pause").unlink()
+        deadline = time.time() + 15
+        while time.time() < deadline and not (cap / "only.done").exists():
+            time.sleep(0.25)
+        assert (cap / "only.done").exists()  # resumed after unpause
+    finally:
+        _killpg(p)
+
+
+def test_lock_released_even_if_stage_child_survives(tmp_path):
+    # Killing the watcher by PID (the documented method) while a stage
+    # child is still running must release the lock: children run with
+    # fd 9 closed, so a restarted watcher takes over instead of bowing
+    # out to a corpse's child.
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    (cap / "stages.txt").write_text("slow|30|sleep 5\n")
+    p1 = _spawn(cap, "true")
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            log = ((cap / "watch.log").read_text()
+                   if (cap / "watch.log").exists() else "")
+            if "stage slow: starting" in log:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("stage never started")
+        os.kill(p1.pid, signal.SIGKILL)  # watcher only; sleep child survives
+        p1.wait()
+        _run_watcher(
+            cap, "true",
+            lambda: (cap / "watch.log").read_text().count("watcher started")
+            >= 2)
+    finally:
+        _killpg(p1)

@@ -31,12 +31,23 @@ SLEEP_S="${R4_SLEEP_S:-120}"
 
 log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
 
+# One watcher per capture dir: a later session starting its own instance
+# must not race this one (two watchers double-running TPU stages through
+# the one relay is exactly the contention that wedges it). Children run
+# with fd 9 closed (9>&-) so the lock really does die with THIS process —
+# a surviving stage child must not make a restarted watcher bow out.
+exec 9>"$OUT/lock"
+if ! flock -n 9; then
+  log "another watcher holds $OUT/lock; exiting (pid $$)"
+  exit 0
+fi
+
 probe() {
   if [ -n "${R4_PROBE_CMD:-}" ]; then
-    timeout -k 10 90 bash -c "$R4_PROBE_CMD" >/dev/null 2>&1
+    timeout -k 10 90 bash -c "$R4_PROBE_CMD" >/dev/null 2>&1 9>&-
     return
   fi
-  timeout -k 10 90 python - >/dev/null 2>&1 <<'EOF'
+  timeout -k 10 90 python - >/dev/null 2>&1 9>&- <<'EOF'
 import jax, jax.numpy as jnp
 x = jnp.ones((128, 128), jnp.bfloat16)
 assert float((x @ x).sum()) > 0
@@ -45,6 +56,12 @@ EOF
 
 log "watcher started (pid $$)"
 while :; do
+  if [ -f "$OUT/pause" ]; then
+    # Operator hook: `touch pause` idles the watcher (e.g. while running
+    # chip work by hand), `rm pause` resumes.
+    sleep "$SLEEP_S"
+    continue
+  fi
   if probe; then
     log "probe ok"
     ran_any=0
@@ -65,7 +82,11 @@ while :; do
       [ "$attempts" -ge 3 ] && continue   # perma-failed; stop burning windows
       ran_any=1
       log "stage $name: starting (timeout ${to}s, attempt $((attempts + 1))/3): $cmd"
-      if timeout -k 30 "$to" bash -c "$cmd" >"$OUT/$name.out" 2>"$OUT/$name.err"; then
+      if [ -f "$OUT/pause" ]; then
+        log "paused mid-window; remaining stages deferred"
+        break
+      fi
+      if timeout -k 30 "$to" bash -c "$cmd" >"$OUT/$name.out" 2>"$OUT/$name.err" 9>&-; then
         touch "$OUT/$name.done"
         # Mirror successful outputs into the tracked captured/ dir so an
         # end-of-session auto-commit preserves them even if no one is
