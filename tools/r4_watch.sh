@@ -29,6 +29,16 @@ mkdir -p "$OUT"
 STAGES="$OUT/stages.txt"
 SLEEP_S="${R4_SLEEP_S:-120}"
 
+# Persistent XLA-compile cache shared by every stage. Compiles go over
+# the relay (PALLAS_AXON_REMOTE_COMPILE=1), so a stage killed by a
+# mid-window wedge re-pays its whole compile budget on retry unless the
+# executables are cached client-side. If the axon PjRt plugin doesn't
+# support executable serialization this is a logged no-op; if it does,
+# retries skip straight to the first uncompiled program.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-2}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
 log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
 
 # One watcher per capture dir: a later session starting its own instance
@@ -59,7 +69,7 @@ while :; do
   if [ -f "$OUT/pause" ]; then
     # Operator hook: `touch pause` idles the watcher (e.g. while running
     # chip work by hand), `rm pause` resumes.
-    sleep "$SLEEP_S"
+    sleep "$SLEEP_S" 9>&-
     continue
   fi
   if probe; then
@@ -113,11 +123,11 @@ while :; do
     done < "$STAGES"
     if [ "$ran_any" = 0 ]; then
       log "no runnable stages (all done or perma-failed); idling"
-      sleep $((SLEEP_S * 5))
+      sleep $((SLEEP_S * 5)) 9>&-
       continue
     fi
   else
     log "probe failed (relay down)"
   fi
-  sleep "$SLEEP_S"
+  sleep "$SLEEP_S" 9>&-
 done
