@@ -1,0 +1,102 @@
+"""The egress watcher (`tools/egress_watch.sh`) probes for network egress
+independently of the TPU relay, logs every probe (the round needs positive
+evidence that egress never opened), and on success queues the real-data
+training stage onto the capture queue and exits.
+
+Driven via the EGRESS_* env hooks (fake probe, tmp log/stage paths, fast
+sleeps) — no network, no jax. Mirrors tests/test_watcher.py.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+WATCH = REPO / "tools" / "egress_watch.sh"
+
+
+def _spawn(tmp: Path, probe_cmd: str):
+    env = dict(
+        os.environ,
+        EGRESS_LOG=str(tmp / "egress.log"),
+        EGRESS_STAGES=str(tmp / "stages.txt"),
+        EGRESS_PROBE_CMD=probe_cmd,
+        EGRESS_SLEEP_S="1",
+    )
+    return subprocess.Popen(["bash", str(WATCH)], env=env, cwd=str(REPO),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL,
+                            preexec_fn=os.setsid)
+
+
+def _killpg(p):
+    try:
+        os.killpg(p.pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    p.wait()
+
+
+def _wait(until, timeout_s: float = 20.0, what: str = ""):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if until():
+            return
+        time.sleep(0.25)
+    pytest.fail(f"egress watcher did not reach expected state: {what}")
+
+
+def test_closed_egress_keeps_probing_and_logging(tmp_path):
+    (tmp_path / "stages.txt").write_text("# queue\n")
+    p = _spawn(tmp_path, "exit 1")
+    try:
+        log = tmp_path / "egress.log"
+        _wait(lambda: log.exists()
+              and log.read_text().count("\n") >= 2, what="two probe cycles")
+        assert p.poll() is None, "watcher must keep running while closed"
+        # The queue must be untouched: no realdata stage without a fetch.
+        assert (tmp_path / "stages.txt").read_text() == "# queue\n"
+    finally:
+        _killpg(p)
+
+
+def test_open_egress_queues_realdata_and_exits(tmp_path):
+    stages = tmp_path / "stages.txt"
+    stages.write_text("# queue\n")
+    p = _spawn(tmp_path, "exit 0")
+    try:
+        _wait(lambda: p.poll() is not None, what="watcher exit on success")
+        assert p.returncode == 0
+        text = stages.read_text()
+        assert "realdata_train|" in text, text
+        # Appended, not inserted: existing queue content keeps priority.
+        assert text.startswith("# queue\n")
+        log = (tmp_path / "egress.log").read_text()
+        assert "egress OPEN" in log and "realdata_train queued" in log
+    finally:
+        _killpg(p)
+
+
+def test_single_instance_flock(tmp_path):
+    (tmp_path / "stages.txt").write_text("")
+    p1 = _spawn(tmp_path, "exit 1")
+    try:
+        log = tmp_path / "egress.log"
+        _wait(lambda: log.exists() and "started" in log.read_text(),
+              what="first instance start")
+        p2 = _spawn(tmp_path, "exit 1")
+        try:
+            _wait(lambda: p2.poll() is not None, what="second instance exit")
+            assert p2.returncode == 0
+            assert "another egress watcher holds" in log.read_text()
+        finally:
+            _killpg(p2)
+        assert p1.poll() is None, "first instance must survive"
+    finally:
+        _killpg(p1)

@@ -160,6 +160,9 @@ def main() -> int:
     ap.add_argument("--dataset", default="cifar10", choices=sorted(SPECS))
     ap.add_argument("--verify", action="store_true",
                     help="only check an existing layout; no network")
+    ap.add_argument("--probe-only", action="store_true",
+                    help="exit 0 iff egress to the dataset host is open; "
+                         "seconds, no chip, no jax — for the capture queue")
     ap.add_argument("--force", action="store_true",
                     help="re-download even if the layout verifies")
     args = ap.parse_args()
@@ -168,6 +171,11 @@ def main() -> int:
 
     if args.verify:
         return 0 if verify_layout(root, args.dataset) else 1
+
+    if args.probe_only:
+        up = egress_available()
+        print(f"egress to {HOST}:443: {'OPEN' if up else 'closed'}")
+        return 0 if up else 2
 
     have = all((root / spec["dirname"] / f).exists() for f in spec["files"])
     if have and not args.force:
