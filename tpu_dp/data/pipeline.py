@@ -37,12 +37,11 @@ import threading
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from tpu_dp.data.cifar import ArrayDataset
 from tpu_dp.data.sampler import ShardedSampler
-from tpu_dp.parallel.dist import DATA_AXIS
-from tpu_dp.parallel.sharding import shard_batch
+from tpu_dp.parallel.sharding import scan_batch_sharding, shard_batch
 
 _END = object()
 
@@ -126,8 +125,10 @@ class DataPipeline:
             yield batch
 
     def _place(self, batch):
-        spec = P(DATA_AXIS) if self.accum_steps == 1 else P(None, DATA_AXIS)
-        return shard_batch(batch, self.mesh, spec=spec)
+        if self.accum_steps == 1:
+            return shard_batch(batch, self.mesh)
+        return shard_batch(batch, self.mesh,
+                           spec=scan_batch_sharding(self.mesh))
 
     def _prefetched(self, placed_items):
         """Drain `placed_items` through the bounded background prefetcher.
@@ -187,25 +188,29 @@ class DataPipeline:
         steps); the epoch's trailing ``len(self) % k`` batches yield as
         ``(1, batch)`` singles for the per-step path — the scanned loop is
         compiled for a fixed window, and padding an optimizer-update window
-        would train on fabricated steps. Requires the training pipeline
-        shape: ``accum_steps == 1`` and ``drop_remainder=True`` (windows
-        carry no weight masks).
+        would train on fabricated steps. With ``accum_steps > 1`` each
+        stacked element is itself a microbatch stack — leaves shaped
+        (k, accum, batch, ...) for the scan-of-scan step. Requires
+        ``drop_remainder=True`` (windows carry no weight masks).
         """
         k = int(k)
         # Validate eagerly (this is a plain function returning a generator,
         # not a generator function) so misconfiguration surfaces at the call
         # site, not at first iteration.
-        if k > 1:
-            if self.accum_steps != 1:
-                raise ValueError("windows(k) requires accum_steps == 1")
-            if not self.drop_remainder:
-                raise ValueError("windows(k) requires drop_remainder=True")
+        if k > 1 and not self.drop_remainder:
+            raise ValueError("windows(k) requires drop_remainder=True")
         return self._windows_iter(k)
 
     def _windows_iter(self, k: int):
         if k <= 1:
             yield from ((1, b) for b in self)
             return
+        # Batch dim after the window axis — and after the microbatch-stack
+        # axis when accumulating. Same helper the step's in_shardings use,
+        # so placement cannot drift from the compiled program.
+        spec = scan_batch_sharding(
+            self.mesh, prefix_dims=1 if self.accum_steps == 1 else 2
+        )
 
         def _host_items():
             buf = []
@@ -216,8 +221,7 @@ class DataPipeline:
                         key: np.stack([bb[key] for bb in buf])
                         for key in buf[0]
                     }
-                    yield (k, shard_batch(pool, self.mesh,
-                                          spec=P(None, DATA_AXIS)))
+                    yield (k, shard_batch(pool, self.mesh, spec=spec))
                     buf = []
             for b in buf:
                 yield (1, self._place(b))

@@ -24,11 +24,12 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
-def scan_batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for batches with a leading scan axis (microbatches under
-    gradient accumulation, step windows under `make_multi_step`): scan dim
+def scan_batch_sharding(mesh: Mesh, prefix_dims: int = 1) -> NamedSharding:
+    """Sharding for batches with ``prefix_dims`` leading scan axes
+    (microbatches under gradient accumulation, step windows under
+    `make_multi_step`, or both at once — scan-of-scan): scan dims
     replicated, batch dim sharded over ``data``."""
-    return NamedSharding(mesh, P(None, DATA_AXIS))
+    return NamedSharding(mesh, P(*([None] * prefix_dims), DATA_AXIS))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

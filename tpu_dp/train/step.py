@@ -174,29 +174,22 @@ def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn):
     return body
 
 
-def make_train_step(
-    model,
-    optimizer: Optimizer,
-    mesh: Mesh,
-    schedule: Schedule,
-    use_pallas_xent: bool = False,
-    accum_steps: int = 1,
-    augment_fn: Callable | None = None,
-) -> Callable:
-    """Build the jitted DP train step for this model/optimizer/mesh.
+def _make_accum_body(
+    model, optimizer, schedule, loss_impl, augment_fn, accum_steps
+):
+    """The gradient-accumulation step body: one optimizer update from
+    ``accum_steps`` sequential microbatches.
 
-    Returns ``step(state, batch) -> (new_state, metrics)`` where ``batch``
-    is the device-placed global batch (leading dim sharded over ``data``)
-    and metrics are replicated scalars: mean loss, correct-prediction count,
-    and example count — the per-step statistics the reference prints
-    (`cifar_example.py:83-87`) plus what its synced eval metric accumulates
-    (`cifar_example_ddp.py:133`).
+    Batch leaves carry a leading (accum_steps,) axis (replicated; the
+    microbatch dim is the sharded one). ``lax.scan`` runs the microbatches
+    sequentially, accumulating grads on-device — how a logical global batch
+    larger than HBM (e.g. BASELINE config 5's 4096) runs on few chips.
+    Shared by `make_train_step` (one dispatch per update) and
+    `make_multi_step` (scan-of-scan: a window of accumulated updates in one
+    program), so the two paths cannot drift apart.
     """
-    repl = replicated_sharding(mesh)
-    batch_sh = batch_sharding(mesh)
-    loss_impl = _select_loss_impl(use_pallas_xent)
 
-    def step_accum(state: TrainState, batch):
+    def body(state: TrainState, batch):
         images, labels = _maybe_normalize(batch["image"]), batch["label"]
         if augment_fn is not None:
             # On-device augmentation keyed by the global step and the
@@ -205,12 +198,7 @@ def make_train_step(
             images = jax.vmap(
                 lambda i, im: augment_fn(state.step * accum_steps + i, im)
             )(jnp.arange(accum_steps), images)
-        # Gradient accumulation: batch leaves carry a leading
-        # (accum_steps,) axis (replicated; the microbatch dim is the
-        # sharded one). lax.scan runs the microbatches sequentially,
-        # accumulating grads on-device; one optimizer update per step.
-        # This is how a logical global batch larger than HBM (e.g.
-        # BASELINE config 5's 4096) runs on few chips.
+
         def micro(carry, mb):
             grads_acc, batch_stats, loss_acc, correct_acc = carry
             mstate = state.replace(batch_stats=batch_stats)
@@ -249,15 +237,50 @@ def make_train_step(
         }
         return new_state, metrics
 
+    return body
+
+
+def _select_body(model, optimizer, schedule, loss_impl, augment_fn,
+                 accum_steps):
+    """One source of truth for the per-update body: plain step at
+    accum_steps == 1, gradient-accumulation body otherwise. Used by both
+    `make_train_step` and `make_multi_step` so the host-loop and
+    device-loop paths share the exact same program."""
+    if accum_steps == 1:
+        return _make_step_body(model, optimizer, schedule, loss_impl,
+                               augment_fn)
+    return _make_accum_body(model, optimizer, schedule, loss_impl,
+                            augment_fn, accum_steps)
+
+
+def make_train_step(
+    model,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    schedule: Schedule,
+    use_pallas_xent: bool = False,
+    accum_steps: int = 1,
+    augment_fn: Callable | None = None,
+) -> Callable:
+    """Build the jitted DP train step for this model/optimizer/mesh.
+
+    Returns ``step(state, batch) -> (new_state, metrics)`` where ``batch``
+    is the device-placed global batch (leading dim sharded over ``data``)
+    and metrics are replicated scalars: mean loss, correct-prediction count,
+    and example count — the per-step statistics the reference prints
+    (`cifar_example.py:83-87`) plus what its synced eval metric accumulates
+    (`cifar_example_ddp.py:133`).
+    """
+    repl = replicated_sharding(mesh)
+    batch_sh = batch_sharding(mesh)
+    loss_impl = _select_loss_impl(use_pallas_xent)
+
     # `batch_sh` is a pytree-prefix: every batch leaf (image, label, and
     # the optional weight mask) shards on its leading dim — or, with
     # accumulation, on the microbatch dim after the scan axis.
-    if accum_steps == 1:
-        step = _make_step_body(model, optimizer, schedule, loss_impl, augment_fn)
-        in_batch_sh = batch_sh
-    else:
-        step = step_accum
-        in_batch_sh = scan_batch_sharding(mesh)
+    step = _select_body(model, optimizer, schedule, loss_impl, augment_fn,
+                        accum_steps)
+    in_batch_sh = batch_sh if accum_steps == 1 else scan_batch_sharding(mesh)
     return jax.jit(
         step,
         in_shardings=(repl, in_batch_sh),
@@ -274,6 +297,7 @@ def make_multi_step(
     num_steps: int,
     use_pallas_xent: bool = False,
     augment_fn: Callable | None = None,
+    accum_steps: int = 1,
 ) -> Callable:
     """Device-side training loop: ``num_steps`` train steps in ONE program.
 
@@ -292,11 +316,19 @@ def make_multi_step(
     pool is cycled modularly *inside* the program (device-side gather per
     step), so HBM cost stays constant in ``num_steps`` — e.g. a benchmark
     can run a 30-step window over 4 staged batches without 30 copies.
+
+    With ``accum_steps > 1`` the scanned body is the gradient-accumulation
+    step (scan-of-scan): batch leaves gain a second leading axis,
+    (pool, accum_steps, microbatch, ...), and each of the ``num_steps``
+    window elements performs one accumulated optimizer update — BASELINE
+    config 5's global-batch-4096 recipe running windowed on a small mesh,
+    where both amortizations (dispatch RTT and HBM) are needed at once.
     """
     repl = replicated_sharding(mesh)
     loss_impl = _select_loss_impl(use_pallas_xent)
 
-    body = _make_step_body(model, optimizer, schedule, loss_impl, augment_fn)
+    body = _select_body(model, optimizer, schedule, loss_impl, augment_fn,
+                        accum_steps)
 
     def loop(state: TrainState, batches):
         pool = jax.tree_util.tree_leaves(batches)[0].shape[0]
@@ -316,8 +348,11 @@ def make_multi_step(
             indexed_body, state, jnp.arange(num_steps, dtype=jnp.int32)
         )
 
-    # Scan axis in front, batch dim sharded over data.
-    in_batch_sh = scan_batch_sharding(mesh)
+    # Scan axis (and, with accumulation, the microbatch-stack axis) in
+    # front, batch dim sharded over data.
+    in_batch_sh = scan_batch_sharding(
+        mesh, prefix_dims=1 if accum_steps == 1 else 2
+    )
     return jax.jit(
         loop,
         in_shardings=(repl, in_batch_sh),

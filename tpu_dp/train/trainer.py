@@ -116,10 +116,6 @@ class Trainer:
         )
         self.eval_step = make_eval_step(self.model, self.mesh)
         self.steps_per_call = max(1, int(cfg.train.steps_per_call))
-        if self.steps_per_call > 1 and cfg.optim.grad_accum_steps > 1:
-            raise ValueError(
-                "train.steps_per_call > 1 requires optim.grad_accum_steps == 1"
-            )
         if self.steps_per_call > 1 and not cfg.data.drop_remainder:
             raise ValueError(
                 "train.steps_per_call > 1 requires data.drop_remainder=true"
@@ -128,11 +124,17 @@ class Trainer:
         if self.steps_per_call > 1:
             from tpu_dp.train.step import make_multi_step
 
+            # Composes with gradient accumulation (scan-of-scan): each
+            # window element is one accumulated optimizer update, so
+            # BASELINE config 5 (global batch 4096) runs windowed on a
+            # small mesh — both the dispatch-RTT and the HBM amortization
+            # at once.
             self.multi_step = make_multi_step(
                 self.model, self.optimizer, self.mesh, self.schedule,
                 num_steps=self.steps_per_call,
                 use_pallas_xent=cfg.train.pallas_xent,
                 augment_fn=augment_fn,
+                accum_steps=cfg.optim.grad_accum_steps,
             )
 
         rng = jax.random.PRNGKey(cfg.train.seed)
