@@ -168,3 +168,48 @@ def test_pipeline_windows_grouping(mesh8):
     with pytest.raises(ValueError):
         list(DataPipeline(ds, 16, mesh8, shuffle=False,
                           drop_remainder=False).windows(4))
+
+
+def test_index_windows_match_windows(mesh8):
+    """index_windows(k) names exactly the examples windows(k) ships.
+
+    Gathering the resident dataset with the yielded indices must reproduce
+    the streaming windows' labels, window for window — the resident path's
+    ordering contract.
+    """
+    from tpu_dp.data.pipeline import DataPipeline
+
+    ds = make_synthetic(9 * 16, 10, seed=0, name="synthetic")
+    pipe = DataPipeline(ds, 16, mesh8, shuffle=True, seed=3, prefetch=0)
+    pipe.set_epoch(1)
+    streamed = [(n, np.asarray(item["label"]))
+                for n, item in pipe.windows(4)]
+    pipe.set_epoch(1)  # same epoch permutation for the index pass
+    indexed = list(pipe.index_windows(4))
+
+    assert [n for n, _ in indexed] == [n for n, _ in streamed] == [4, 4, 1]
+    for (n, labels), (_, idx) in zip(streamed, indexed):
+        idx = np.asarray(idx)
+        assert idx.dtype == np.int32
+        assert idx.shape == (n, 16)
+        gathered = ds.labels[idx]
+        np.testing.assert_array_equal(
+            labels if n > 1 else labels[None], gathered
+        )
+
+    with pytest.raises(ValueError):
+        DataPipeline(ds, 16, mesh8, shuffle=False,
+                     drop_remainder=False).index_windows(4)
+
+
+def test_index_windows_accum_shape(mesh8):
+    from tpu_dp.data.pipeline import DataPipeline
+
+    ds = make_synthetic(128, 10, seed=0, name="synthetic")
+    pipe = DataPipeline(ds, 16, mesh8, shuffle=False, prefetch=0,
+                        accum_steps=2)
+    items = list(pipe.index_windows(2))  # 4 updates → 2 windows of 2
+    assert [n for n, _ in items] == [2, 2]
+    assert items[0][1].shape == (2, 2, 16)  # (window, accum, batch)
+    flat = np.concatenate([np.asarray(i).ravel() for _, i in items])
+    np.testing.assert_array_equal(flat, np.arange(128, dtype=np.int32))

@@ -45,6 +45,14 @@ class DataConfig:
     synthetic_train_size: int | None = None
     synthetic_test_size: int | None = None
     allow_synthetic: bool = True
+    # Stage the whole train set in HBM once and feed the compiled window
+    # only int32 indices (~KB/step instead of ~MB/step host gather +
+    # transfer — the reference's per-step DataLoader feed,
+    # `cifar_example.py:46-52`, replaced by on-device indexing).
+    # "auto": on when the train set fits resident_max_bytes and
+    # drop_remainder holds; "on"/"off" force it.
+    device_resident: str = "auto"  # auto | on | off
+    resident_max_bytes: int = 512 * 1024 * 1024
 
 
 @dataclass

@@ -361,6 +361,60 @@ def make_multi_step(
     )
 
 
+def make_multi_step_resident(
+    model,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    schedule: Schedule,
+    num_steps: int,
+    use_pallas_xent: bool = False,
+    augment_fn: Callable | None = None,
+    accum_steps: int = 1,
+) -> Callable:
+    """Windowed training loop fed by a device-resident dataset + indices.
+
+    The end-to-end feed redesign (VERDICT r4 next-steps #3): instead of the
+    host gathering and shipping ~MBs of batch per step (the reference's
+    DataLoader feed, `/root/reference/cifar_example.py:46-52`), the whole
+    train set is staged in HBM once (CIFAR-10: 150 MB uint8) and each window
+    dispatch carries only int32 *indices* — (num_steps, [accum,] batch),
+    ~KBs. The compiled program gathers each step's batch on-device from the
+    replicated dataset (the gather partitions trivially: indices are
+    sharded over ``data``, the operand is replicated, so every device
+    gathers exactly its shard's examples), then runs the same shared step
+    body as `make_multi_step` — normalize/augment/fwd/bwd/update all
+    unchanged and trajectory-identical (equivalence-tested).
+
+    Returns ``loop(state, data, idx) -> (new_state, stacked_metrics)``:
+    ``data`` leaves are (N, ...) device-resident (replicated; uint8 images
+    fine — normalization is in-body), ``idx`` is int32 with the window axis
+    in front. Only ``state`` is donated — ``data`` must survive the call.
+    """
+    repl = replicated_sharding(mesh)
+    loss_impl = _select_loss_impl(use_pallas_xent)
+    body = _select_body(model, optimizer, schedule, loss_impl, augment_fn,
+                        accum_steps)
+
+    def loop(state: TrainState, data, idx):
+        def indexed_body(st, idx_step):
+            mb = jax.tree_util.tree_map(lambda x: x[idx_step], data)
+            return body(st, mb)
+
+        # length pins the window size: a mis-shaped idx errors at trace
+        # time instead of silently running a different number of steps.
+        return jax.lax.scan(indexed_body, state, idx, length=num_steps)
+
+    idx_sh = scan_batch_sharding(
+        mesh, prefix_dims=1 if accum_steps == 1 else 2
+    )
+    return jax.jit(
+        loop,
+        in_shardings=(repl, repl, idx_sh),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+
+
 def make_train_step_shard_map(
     model,
     optimizer: Optimizer,

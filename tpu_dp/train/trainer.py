@@ -108,6 +108,7 @@ class Trainer:
             from tpu_dp.data.augment import make_augment_fn
 
             augment_fn = make_augment_fn(cfg.train.seed + 1)
+        self._augment_fn = augment_fn
         self.train_step = make_train_step(
             self.model, self.optimizer, self.mesh, self.schedule,
             use_pallas_xent=cfg.train.pallas_xent,
@@ -136,6 +137,29 @@ class Trainer:
                 augment_fn=augment_fn,
                 accum_steps=cfg.optim.grad_accum_steps,
             )
+
+        # Device-resident feed (VERDICT r4 next-steps #3): stage the train
+        # set in HBM once; per-window dispatch ships only indices. The
+        # trajectory is identical to the streaming path (same sampler
+        # order, same step body — equivalence-tested); what changes is the
+        # host work per step: ~KB of int32 instead of a ~MB gather+copy.
+        self.resident_train = None
+        self._resident_loops: dict[int, Any] = {}
+        mode = cfg.data.device_resident
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"data.device_resident must be auto|on|off, got {mode!r}"
+            )
+        if mode == "on" and not cfg.data.drop_remainder:
+            raise ValueError(
+                "data.device_resident=on requires data.drop_remainder=true"
+            )
+        if mode == "on" or (
+            mode == "auto"
+            and cfg.data.drop_remainder
+            and self.train_pipe.dataset_bytes() <= cfg.data.resident_max_bytes
+        ):
+            self.resident_train = self.train_pipe.resident_data()
 
         rng = jax.random.PRNGKey(cfg.train.seed)
         sample = np.zeros((1, 32, 32, 3), np.float32)
@@ -229,6 +253,22 @@ class Trainer:
         return (self.cfg.data.batch_size * self.ctx.process_count
                 * self.cfg.optim.grad_accum_steps)
 
+    def _resident_loop(self, n: int):
+        """Compiled resident window program for window size ``n`` (cached;
+        an epoch uses at most two sizes: steps_per_call and 1)."""
+        loop = self._resident_loops.get(n)
+        if loop is None:
+            from tpu_dp.train.step import make_multi_step_resident
+
+            loop = make_multi_step_resident(
+                self.model, self.optimizer, self.mesh, self.schedule,
+                num_steps=n, use_pallas_xent=self.cfg.train.pallas_xent,
+                augment_fn=self._augment_fn,
+                accum_steps=self.cfg.optim.grad_accum_steps,
+            )
+            self._resident_loops[n] = loop
+        return loop
+
     def train_epoch(self, epoch: int) -> dict[str, float]:
         cfg = self.cfg
         self.train_pipe.set_epoch(epoch)  # `cifar_example_ddp.py:92` parity
@@ -237,18 +277,32 @@ class Trainer:
         ep_loss = ep_correct = None
         ep_steps, ep_count = 0, 0
         i = -1
-        for n, item in self.train_pipe.windows(self.steps_per_call):
-            if n == 1:
+        if self.resident_train is not None:
+            items = self.train_pipe.index_windows(self.steps_per_call)
+        else:
+            items = self.train_pipe.windows(self.steps_per_call)
+        def _unstack(stacked, n):
+            # Lazy per-step views over the window's stacked metrics — still
+            # no host sync outside log boundaries.
+            return tuple(
+                {k: v[j] for k, v in stacked.items()} for j in range(n)
+            )
+
+        for n, item in items:
+            if self.resident_train is not None:
+                # Indices in, stacked metrics out — the dataset never
+                # re-crosses the host→device link.
+                self.state, stacked = self._resident_loop(n)(
+                    self.state, self.resident_train, item
+                )
+                window = _unstack(stacked, n)
+            elif n == 1:
                 self.state, m = self.train_step(self.state, item)
                 window = (m,)
             else:
-                # One dispatch, n optimizer steps (device-side scanned
-                # loop); stacked metrics index lazily below — still no
-                # host sync outside log boundaries.
+                # One dispatch, n optimizer steps (device-side scanned loop).
                 self.state, stacked = self.multi_step(self.state, item)
-                window = tuple(
-                    {k: v[j] for k, v in stacked.items()} for j in range(n)
-                )
+                window = _unstack(stacked, n)
             for m in window:
                 i += 1
                 # On-device async adds; no host sync inside the loop.
