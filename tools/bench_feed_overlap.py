@@ -18,7 +18,14 @@ stack + relay transfer on this single-core host) is the bottleneck and
 deeper prefetch cannot help past CPU saturation. Run with --prefetch 0 for
 the no-overlap baseline.
 
-Prints one JSON line per (prefetch, epoch).
+--feed resident (or both) additionally measures the device-resident path
+(`DataPipeline.index_windows` -> `make_multi_step_resident`, the
+production default): the dataset is staged in HBM once and each window
+ships only int32 indices, so wait_s should collapse to ~0 regardless of
+host speed — the designed fix for the end-to-end gap (VERDICT r4
+next-steps #3).
+
+Prints one JSON line per (feed, prefetch, epoch).
 
   python tools/bench_feed_overlap.py                    # longrun shape, TPU
   python tools/bench_feed_overlap.py --platform cpu --train-size 2048 \
@@ -48,6 +55,9 @@ def main() -> None:
     ap.add_argument("--platform", default=None, choices=["cpu"],
                     help="force cpu (harness smoke test; the env's "
                          "sitecustomize pins the tpu backend)")
+    ap.add_argument("--feed", default="both",
+                    choices=["streaming", "resident", "both"],
+                    help="which feed path(s) to measure")
     args = ap.parse_args()
 
     import jax
@@ -72,22 +82,27 @@ def main() -> None:
     state0 = create_train_state(model, jax.random.PRNGKey(0),
                                 np.zeros((1, 32, 32, 3), np.float32), opt)
     steps = (args.train_size // gb // args.window) * args.window
-    loop = make_multi_step(model, opt, mesh,
-                           cosine_lr(0.4, max(steps, 1) * args.epochs, 1),
-                           num_steps=args.window)
+    # One schedule and one pipeline recipe shared by both feeds: the tool's
+    # whole point is an apples-to-apples comparison.
+    sched = cosine_lr(0.4, max(steps, 1) * args.epochs, 1)
 
-    for pf in [int(p) for p in args.prefetch.split(",")]:
-        pipe = DataPipeline(ds, gb, mesh, shuffle=True, seed=0,
+    def make_pipe(pf):
+        return DataPipeline(ds, gb, mesh, shuffle=True, seed=0,
                             drop_remainder=True, prefetch=pf)
-        # The scanned loop donates its input state; each depth needs a
-        # fresh copy or depth 2 would step on depth 1's deleted buffers.
+
+    loop = make_multi_step(model, opt, mesh, sched, num_steps=args.window)
+
+    def run(feed, pf, pipe, step_fn):
+        # The scanned loop donates its input state; each run needs a
+        # fresh copy or run 2 would step on run 1's deleted buffers.
         state = jax.tree_util.tree_map(jnp.copy, state0)
         for epoch in range(args.epochs):
             pipe.set_epoch(epoch)
             wait_s = step_s = 0.0
             n_imgs = 0
             t_epoch = time.perf_counter()
-            it = pipe.windows(args.window)
+            it = (pipe.index_windows(args.window) if feed == "resident"
+                  else pipe.windows(args.window))
             while True:
                 t0 = time.perf_counter()
                 try:
@@ -97,7 +112,7 @@ def main() -> None:
                 t1 = time.perf_counter()
                 if n == 1:
                     continue  # trailing singles: not the measured path
-                state, m = loop(state, item)
+                state, m = step_fn(state, item)
                 # Fence: scalar fetch (block_until_ready can return early
                 # on this relay transport — docs/DESIGN.md).
                 float(m["loss"][-1])
@@ -106,7 +121,7 @@ def main() -> None:
                 step_s += t2 - t1
                 n_imgs += n * gb
             total = time.perf_counter() - t_epoch
-            rec = {"prefetch": pf, "epoch": epoch,
+            rec = {"feed": feed, "prefetch": pf, "epoch": epoch,
                    "img_per_s": round(n_imgs / total, 1),
                    "total_s": round(total, 3),
                    "wait_s": round(wait_s, 3),
@@ -116,8 +131,22 @@ def main() -> None:
                    "backend": jax.default_backend(),
                    "device": jax.devices()[0].device_kind}
             print(json.dumps(rec), flush=True)
-            # epoch 0 of each depth includes compile (cached after the
-            # first depth) — compare epochs >= 1.
+            # epoch 0 of each run includes compile (cached after the
+            # first) — compare epochs >= 1.
+
+    if args.feed in ("streaming", "both"):
+        for pf in [int(p) for p in args.prefetch.split(",")]:
+            run("streaming", pf, make_pipe(pf), loop)
+
+    if args.feed in ("resident", "both"):
+        from tpu_dp.train.step import make_multi_step_resident
+
+        pipe = make_pipe(0)
+        rdata = pipe.resident_data()
+        rloop = make_multi_step_resident(model, opt, mesh, sched,
+                                         num_steps=args.window)
+        run("resident", 0, pipe,
+            lambda state, idx: rloop(state, rdata, idx))
 
 
 if __name__ == "__main__":
