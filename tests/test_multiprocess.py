@@ -351,6 +351,92 @@ jax.distributed.shutdown()
 """
 
 
+_RESIDENT_WORKER = r"""
+import os, pickle, sys
+rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
+out_path = sys.argv[4]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=world,
+                           process_id=rank)
+import numpy as np
+from tpu_dp.data.cifar import make_synthetic
+from tpu_dp.data.pipeline import DataPipeline
+from tpu_dp.models import Net
+from tpu_dp.parallel import dist
+from tpu_dp.train import SGD, constant_lr, create_train_state
+from tpu_dp.train.step import make_multi_step, make_multi_step_resident
+
+mesh = dist.data_mesh()
+ds = make_synthetic(64, 10, seed=0, name="mpres")  # identical on both ranks
+model, opt = Net(), SGD(0.9)
+
+def fresh_state():
+    return create_train_state(model, jax.random.PRNGKey(0),
+                              np.zeros((1, 32, 32, 3), np.float32), opt)
+
+pipe = DataPipeline(ds, batch_size=8, mesh=mesh, shuffle=True, seed=7,
+                    prefetch=0)
+# Resident: dataset assembled replicated from both processes, windows fed
+# by process-locally assembled sharded indices.
+rdata = pipe.resident_data()
+rloop = make_multi_step_resident(model, opt, mesh, constant_lr(0.05),
+                                 num_steps=2)
+pipe.set_epoch(0)
+state = fresh_state()
+for n, idx in pipe.index_windows(2):   # 4 steps -> 2 windows of 2
+    assert n == 2
+    state, m = rloop(state, rdata, idx)
+res_loss = float(m["loss"][-1])
+
+# Streaming control: same sampler order, same body.
+sloop = make_multi_step(model, opt, mesh, constant_lr(0.05), num_steps=2)
+pipe.set_epoch(0)
+sstate = fresh_state()
+for n, item in pipe.windows(2):
+    assert n == 2, "control loop expects full windows only"
+    sstate, sm = sloop(sstate, item)
+
+import jax.numpy as jnp
+digest_fn = jax.jit(lambda p: sum(
+    jnp.abs(l).sum() for l in jax.tree_util.tree_leaves(p)))
+res_digest = float(digest_fn(state.params))
+stream_digest = float(digest_fn(sstate.params))
+with open(out_path, "wb") as f:
+    pickle.dump(dict(rank=rank, res_loss=res_loss,
+                     stream_loss=float(sm["loss"][-1]),
+                     res_digest=res_digest,
+                     stream_digest=stream_digest), f)
+jax.distributed.shutdown()
+"""
+
+
+@pytest.mark.slow
+def test_two_process_resident_feed(tmp_path):
+    """The device-resident feed under a true multi-process mesh: replicated
+    dataset assembly + process-locally assembled sharded index windows must
+    reproduce the streaming trajectory exactly, with replicated outputs in
+    lockstep across processes."""
+    world, port = 2, _free_port()
+    outs = [tmp_path / f"res{rank}.pkl" for rank in range(world)]
+    _spawn_workers(
+        tmp_path, _RESIDENT_WORKER,
+        [(rank, world, port, outs[rank]) for rank in range(world)],
+        name="resident_mp",
+    )
+    results = [pickle.loads(o.read_bytes()) for o in outs]
+    # Resident ≡ streaming on each rank (same examples, same order).
+    for r in results:
+        assert r["res_loss"] == pytest.approx(r["stream_loss"], rel=1e-6)
+        assert r["res_digest"] == pytest.approx(r["stream_digest"], rel=1e-6)
+    # Replicated outputs agree across processes.
+    assert results[0]["res_loss"] == pytest.approx(
+        results[1]["res_loss"], rel=1e-6)
+    assert results[0]["res_digest"] == pytest.approx(
+        results[1]["res_digest"], rel=1e-6)
+
+
 @pytest.mark.slow
 def test_two_process_fused_conv_step(tmp_path):
     """The fused Pallas-conv model under a true multi-process mesh: the
