@@ -152,6 +152,36 @@ def test_steps_per_call_matches_per_step_trajectory(tmp_path, capsys):
     assert lines1 and lines1 == lines4
 
 
+def test_steps_per_call_auto(tmp_path):
+    """steps_per_call=0 picks a window automatically (≤24, ≤steps/epoch)
+    and still matches the per-step trajectory."""
+    cfg = _tiny_cfg(tmp_path / "auto")
+    cfg.data.synthetic_train_size = 128  # 4 steps of 32
+    cfg.train.steps_per_call = 0
+    tr = Trainer(cfg)
+    assert tr.steps_per_call == 4  # min(24, steps_per_epoch)
+    res = tr.fit()
+
+    cfg1 = _tiny_cfg(tmp_path / "per_step")
+    cfg1.data.synthetic_train_size = 128
+    res1 = Trainer(cfg1).fit()
+    assert len(res["history"]) == len(res1["history"]) == 2
+    for a, b in zip(res["history"], res1["history"]):
+        assert a["loss"] == pytest.approx(b["loss"], rel=1e-6)
+
+    with pytest.raises(ValueError):
+        cfg_neg = _tiny_cfg(tmp_path / "neg")
+        cfg_neg.train.steps_per_call = -1
+        Trainer(cfg_neg)
+
+    # Auto falls back to per-step when windows are unavailable.
+    cfg2 = _tiny_cfg(tmp_path / "auto_nodrop")
+    cfg2.data.synthetic_train_size = 128
+    cfg2.train.steps_per_call = 0
+    cfg2.data.drop_remainder = False
+    assert Trainer(cfg2).steps_per_call == 1
+
+
 def test_device_resident_matches_streaming_trajectory(tmp_path):
     """data.device_resident=on ≡ off: same sampler order, same step body,
     same trajectory — only the feed mechanics differ (indices vs batches).

@@ -77,8 +77,10 @@ class TrainConfig:
     eval_at_end: bool = True
     eval_every_epochs: int = 0  # 0 = only at end
     # Steps fused into one device dispatch via the scanned loop (1 = the
-    # plain per-step path). Amortizes launch latency; requires
-    # grad_accum_steps == 1. The epoch's trailing steps run per-step.
+    # plain per-step path; 0 = auto — up-to-24-step windows whenever the
+    # pipeline shape allows). Amortizes launch latency; composes with
+    # grad_accum_steps (scan-of-scan). The epoch's trailing steps run
+    # per-step.
     steps_per_call: int = 1
     ckpt_dir: str = "./checkpoints"
     ckpt_keep: int = 3       # retained step checkpoints (0 = keep all)

@@ -116,7 +116,18 @@ class Trainer:
             augment_fn=augment_fn,
         )
         self.eval_step = make_eval_step(self.model, self.mesh)
-        self.steps_per_call = max(1, int(cfg.train.steps_per_call))
+        spc = int(cfg.train.steps_per_call)
+        if spc < 0:
+            raise ValueError(
+                f"train.steps_per_call must be >= 0 (0 = auto), got {spc}"
+            )
+        if spc == 0:
+            # Auto: windowed dispatch whenever the pipeline shape allows.
+            # 24 steps/window matches the longrun recipe — big enough to
+            # amortize a high-RTT dispatch, small enough to keep the
+            # log cadence and HBM batch staging reasonable.
+            spc = min(24, steps_per_epoch) if cfg.data.drop_remainder else 1
+        self.steps_per_call = max(1, spc)
         if self.steps_per_call > 1 and not cfg.data.drop_remainder:
             raise ValueError(
                 "train.steps_per_call > 1 requires data.drop_remainder=true"
