@@ -347,6 +347,55 @@ def measure_point(cfg: dict) -> dict:
         elapsed = time.perf_counter() - t0
         n_steps_timed = measure_steps
 
+    snap_every = int(cfg.get("snapshot_every", 0))
+    snapshot_rec = None
+    if snap_every > 0:
+        # Async-snapshot overhead (docs/RESILIENCE.md "<2% at cadence 50"):
+        # time the identical loop twice — plain, then with a SnapshotManager
+        # consulted at every host step boundary — over enough steps for at
+        # least two snapshots to fire, so the device→host double-buffer copy
+        # AND the overlapped background write are both in steady state.
+        import tempfile
+
+        from tpu_dp.resilience import SnapshotManager
+
+        if window > 1:
+            reps = max(2, -(-2 * snap_every // window))
+
+            def timed(hook):
+                nonlocal state
+                hs = 0
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    state, m = loop_exe(state, pool)
+                    hs += window
+                    hook(state, hs)
+                    float(m["loss"][-1])  # per-window fence (both runs)
+                return (time.perf_counter() - t0) / (reps * window)
+        else:
+            reps = max(measure_steps, 2 * snap_every)
+
+            def timed(hook):
+                nonlocal state
+                t0 = time.perf_counter()
+                for i in range(reps):
+                    state, m = step_exe(state, batches[i % len(batches)])
+                    hook(state, i + 1)
+                float(m["loss"])
+                return (time.perf_counter() - t0) / reps
+
+        plain_s = timed(lambda s, n: None)
+        with tempfile.TemporaryDirectory() as snap_dir:
+            snap = SnapshotManager(snap_dir, every_steps=snap_every, keep=2)
+            snap_s = timed(lambda s, n: snap.maybe(s, n, {"bench": True}))
+            snap.close()
+        snapshot_rec = {
+            "every_steps": snap_every,
+            "ms_per_step_plain": round(plain_s * 1e3, 3),
+            "ms_per_step_snapshot": round(snap_s * 1e3, 3),
+            "overhead_pct": round((snap_s / plain_s - 1.0) * 100, 2),
+        }
+
     images_per_sec = n_steps_timed * global_batch / elapsed
     per_chip_ips = images_per_sec / n_chips
     device_kind = jax.devices()[0].device_kind
@@ -357,7 +406,7 @@ def measure_point(cfg: dict) -> dict:
         if flops_per_step and peak:
             # cost_analysis reports the per-device SPMD module's FLOPs.
             mfu = round(flops_per_step * n_steps_timed / elapsed / peak, 4)
-        return {
+        rec = {
             "metric": metric,
             "value": round(per_chip_ips, 1),
             "unit": UNIT,
@@ -383,6 +432,9 @@ def measure_point(cfg: dict) -> dict:
                 "fused_bwd": bool(cfg.get("fused_bwd", False)),
             },
         }
+        if snapshot_rec is not None:
+            rec["snapshot"] = snapshot_rec
+        return rec
 
     if window > 1:
         # FLOPs truth comes from the loop-free w1 step (compiled for cost
@@ -514,6 +566,10 @@ def main() -> None:
                          "path; also the schedule horizon")
     ap.add_argument("--steps-per-call", type=int, default=30,
                     help="scan-window length of the headline point")
+    ap.add_argument("--snapshot-every", type=int, default=0,
+                    help="also measure async-snapshot overhead at this step "
+                         "cadence (tpu_dp.resilience.SnapshotManager; the "
+                         "record gains a 'snapshot' block with overhead_pct)")
     ap.add_argument("--probe-timeout", type=float, default=75.0)
     ap.add_argument("--probe-attempts", type=int, default=3)
     ap.add_argument("--probe-retry-wait", type=float, default=15.0)
@@ -560,7 +616,8 @@ def main() -> None:
 
     base = {"measure_steps": args.measure_steps, "platform": args.platform,
             "model": args.model, "fused_stages": args.fused_stages,
-            "fused_block_b": args.fused_block_b, "fused_bwd": args.fused_bwd}
+            "fused_block_b": args.fused_block_b, "fused_bwd": args.fused_bwd,
+            "snapshot_every": args.snapshot_every}
     if args.sweep:
         grid = [
             dict(base, per_chip_batch=b, pallas_xent=px, steps_per_call=w)

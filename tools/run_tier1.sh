@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Tier-1 verify gate (ROADMAP.md), wrapped so CI and humans run the exact
+# same command. Usage:
+#
+#   tools/run_tier1.sh               # full tier-1 suite (CPU backend)
+#   tools/run_tier1.sh --resilience  # fast lane: only -m resilience tests
+#
+# Exit code is pytest's; the DOTS_PASSED line echoes the pass count the
+# roadmap tracks across PRs.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+LOG=${TIER1_LOG:-/tmp/_t1.log}
+
+if [ "${1:-}" = "--resilience" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m resilience \
+        -p no:cacheprovider
+fi
+
+rm -f "$LOG"
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+exit "$rc"

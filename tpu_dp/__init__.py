@@ -11,7 +11,17 @@ different mesh shapes — erasing the single/DDP script fork that structures the
 reference (`/root/reference/cifar_example.py` vs `cifar_example_ddp.py`).
 """
 
-from tpu_dp import config, data, metrics, models, ops, parallel, train, utils
+from tpu_dp import (
+    config,
+    data,
+    metrics,
+    models,
+    ops,
+    parallel,
+    resilience,
+    train,
+    utils,
+)
 from tpu_dp.checkpoint import (
     CheckpointManager,
     load_checkpoint,
@@ -36,6 +46,7 @@ __all__ = [
     "models",
     "ops",
     "parallel",
+    "resilience",
     "save_checkpoint",
     "train",
     "utils",

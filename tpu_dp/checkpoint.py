@@ -138,14 +138,21 @@ class CheckpointManager:
             ) from err
 
     def save(self, state: TrainState, meta: dict[str, Any] | None = None,
-             step: int | None = None) -> Path | None:
-        """Checkpoint ``state`` under ``step_<n>`` (n defaults to state.step)."""
+             step: int | None = None, host_state=None) -> Path | None:
+        """Checkpoint ``state`` under ``step_<n>`` (n defaults to state.step).
+
+        ``host_state`` lets a caller hand over an already-materialized host
+        copy of ``state`` (the resilience snapshot layer's double buffer)
+        instead of paying a fresh device→host copy + allocation here; the
+        buffer must stay untouched until the next ``save``/``wait``.
+        """
         if jax.process_index() != 0:
             return None
         self.wait()
         n = int(state.step) if step is None else int(step)
         step_dir = self.ckpt_dir / f"step_{n:010d}"
-        host_state = _to_host(state)  # snapshot NOW: donation-safe, consistent
+        if host_state is None:
+            host_state = _to_host(state)  # snapshot NOW: donation-safe
 
         def _write():
             _atomic_write_state(step_dir, host_state, meta)

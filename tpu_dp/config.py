@@ -91,6 +91,25 @@ class TrainConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Preemption-aware fault tolerance (tpu_dp/resilience/, docs/RESILIENCE.md)."""
+
+    # Async TrainState snapshot cadence in optimizer steps; 0 = off (the
+    # per-epoch checkpoint in Trainer.fit still runs either way).
+    snapshot_every_steps: int = 0
+    snapshot_keep: int = 2       # retained step snapshots (GC'd beyond this)
+    snapshot_dir: str = ""       # "" = <train.ckpt_dir>/snapshots
+    # SIGTERM/SIGINT → final snapshot → barrier → exit 143 during fit().
+    handle_signals: bool = True
+    # Bounded exponential backoff for resilient collectives (ResilientRing).
+    max_retries: int = 2
+    retry_base_delay_s: float = 0.05
+    # Deterministic fault injection spec (testing only; see
+    # tpu_dp/resilience/faultinject.py), e.g. "kill:step=13,rank=1".
+    fault: str = ""
+
+
+@dataclass
 class ParallelConfig:
     num_devices: int | None = None  # None = all visible devices
     coordinator_address: str | None = None
@@ -105,6 +124,7 @@ class Config:
     optim: OptimConfig = field(default_factory=OptimConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     def override(self, dotted: str, value: str) -> None:
         """Apply one ``section.field=value`` override, coercing to field type."""
@@ -294,6 +314,16 @@ def parse_cli(argv: Sequence[str]) -> Config:
                 from_meta = True
             payload.pop("parallel", None)  # environment, not experiment
             cfg = Config.from_dict(payload)
+        elif key == "resume":
+            # `--resume=auto` (or bare `--resume`): continue from the newest
+            # checkpoint/snapshot when one exists, start fresh otherwise —
+            # the restart command an auto-restarting supervisor can always
+            # pass (docs/RESILIENCE.md "Auto-resume").
+            if value not in ("", "auto", "true", "1", "latest"):
+                raise ValueError(
+                    f"--resume takes auto|true|latest, got {value!r}"
+                )
+            overrides.append(("train.resume", "true"))
         else:
             overrides.append((key, value))
     resume_on = any(

@@ -95,13 +95,20 @@ class DataPipeline:
             return shard // per_step
         return -(-shard // per_step)  # ceil
 
-    def _host_batches(self):
-        """Yield host-side numpy batches for this process's shard."""
+    def _host_batches(self, skip_steps: int = 0):
+        """Yield host-side numpy batches for this process's shard.
+
+        ``skip_steps`` fast-forwards past the epoch's first N optimizer
+        steps without touching the data arrays — the resume path after a
+        mid-epoch snapshot (no batch replayed, none skipped: step ``s``
+        always draws ``idx[s*per_step:(s+1)*per_step]`` regardless of
+        where iteration starts).
+        """
         images, labels = self.dataset.images, self.dataset.labels
         idx = self.sampler.shard_indices()
         per_step = self.batch_size * self.accum_steps
         steps = len(self)
-        for s in range(steps):
+        for s in range(int(skip_steps), steps):
             take = idx[s * per_step : (s + 1) * per_step]
             weight = None
             if len(take) < per_step:
@@ -198,7 +205,7 @@ class DataPipeline:
         return shard_batch(data, self.mesh,
                            spec=replicated_sharding(self.mesh))
 
-    def index_windows(self, k: int):
+    def index_windows(self, k: int, skip_steps: int = 0):
         """Yield ``(n_steps, idx_device)`` windows of dataset indices.
 
         The resident-path twin of `windows`: same sampler order, same
@@ -206,15 +213,18 @@ class DataPipeline:
         each item is an int32 index array — (n, [accum,] batch), sharded on
         the batch dim — instead of the gathered examples. ~KBs per window
         over the host→device link instead of ~MBs per step.
+        ``skip_steps`` resumes mid-epoch: the remaining steps re-window
+        from the resume point (same step order; grouping may differ from
+        the uninterrupted epoch's).
         """
         k = int(k)
         if not self.drop_remainder:
             # No weight masks in the resident train path (same invariant as
             # `windows`); eval keeps the standard pipeline.
             raise ValueError("index_windows requires drop_remainder=True")
-        return self._index_windows_iter(k)
+        return self._index_windows_iter(k, int(skip_steps))
 
-    def _index_windows_iter(self, k: int):
+    def _index_windows_iter(self, k: int, skip_steps: int = 0):
         # No prefetch wrapper: index windows are KB-scale; placement is an
         # async device_put that never becomes the bottleneck.
         idx = np.ascontiguousarray(self.sampler.shard_indices(), np.int32)
@@ -222,11 +232,12 @@ class DataPipeline:
         steps = len(self)
         step_shape = ((self.batch_size,) if self.accum_steps == 1
                       else (self.accum_steps, self.batch_size))
-        full = steps - steps % k if k > 1 else 0
+        remaining = max(0, steps - skip_steps)
+        full = skip_steps + (remaining - remaining % k if k > 1 else 0)
         spec = scan_batch_sharding(
             self.mesh, prefix_dims=1 if self.accum_steps == 1 else 2
         )
-        for s in range(0, full, k):
+        for s in range(skip_steps, full, k):
             take = idx[s * per_step : (s + k) * per_step]
             yield (k, shard_batch(take.reshape(k, *step_shape),
                                   self.mesh, spec=spec))
@@ -235,7 +246,7 @@ class DataPipeline:
             yield (1, shard_batch(take.reshape(1, *step_shape),
                                   self.mesh, spec=spec))
 
-    def windows(self, k: int):
+    def windows(self, k: int, skip_steps: int = 0):
         """Yield ``(n_steps, device_item)`` pairs for `make_multi_step`.
 
         Full windows stack ``k`` consecutive host batches on a leading scan
@@ -247,6 +258,7 @@ class DataPipeline:
         stacked element is itself a microbatch stack — leaves shaped
         (k, accum, batch, ...) for the scan-of-scan step. Requires
         ``drop_remainder=True`` (windows carry no weight masks).
+        ``skip_steps`` resumes mid-epoch (see `_host_batches`).
         """
         k = int(k)
         # Validate eagerly (this is a plain function returning a generator,
@@ -254,11 +266,12 @@ class DataPipeline:
         # site, not at first iteration.
         if k > 1 and not self.drop_remainder:
             raise ValueError("windows(k) requires drop_remainder=True")
-        return self._windows_iter(k)
+        return self._windows_iter(k, int(skip_steps))
 
-    def _windows_iter(self, k: int):
+    def _windows_iter(self, k: int, skip_steps: int = 0):
         if k <= 1:
-            yield from ((1, b) for b in self)
+            placed = (self._place(b) for b in self._host_batches(skip_steps))
+            yield from ((1, b) for b in self._prefetched(placed))
             return
         # Batch dim after the window axis — and after the microbatch-stack
         # axis when accumulating. Same helper the step's in_shardings use,
@@ -269,7 +282,7 @@ class DataPipeline:
 
         def _host_items():
             buf = []
-            for b in self._host_batches():
+            for b in self._host_batches(skip_steps):
                 buf.append(b)
                 if len(buf) == k:
                     pool = {

@@ -11,13 +11,59 @@ code falls back to the op's identical XLA statement there).
 
 from __future__ import annotations
 
+import inspect
 import logging
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.custom_partitioning import (
+    custom_partitioning as _custom_partitioning,
+)
 from jax.sharding import NamedSharding
 
 logger = logging.getLogger(__name__)
+
+# --- JAX version adaptation -------------------------------------------------
+# The vma (varying-mesh-axes) machinery — `jax.typeof`, avals carrying `vma`,
+# `ShapeDtypeStruct(..., vma=...)` — and `def_partition(sharding_rule=...)`
+# only exist in newer JAX. Detect each capability once; older installs get
+# the no-vma behavior (their shard_map has no check_vma to satisfy).
+
+_HAS_TYPEOF = hasattr(jax, "typeof")
+try:
+    jax.ShapeDtypeStruct((1,), jnp.float32, vma=frozenset())
+    _HAS_VMA_STRUCT = True
+except TypeError:
+    _HAS_VMA_STRUCT = False
+_HAS_SHARDING_RULE = "sharding_rule" in inspect.signature(
+    _custom_partitioning.def_partition
+).parameters
+
+
+def def_partition(cp, *, partition, infer_sharding_from_operands,
+                  sharding_rule=None):
+    """`cp.def_partition` across JAX versions.
+
+    Newer JAX (Shardy) wants the `sharding_rule` mini-language string;
+    older `def_partition` signatures reject the kwarg outright — pass it
+    only where it exists (the GSPMD callbacks carry the same information).
+    """
+    kwargs = dict(partition=partition,
+                  infer_sharding_from_operands=infer_sharding_from_operands)
+    if sharding_rule is not None and _HAS_SHARDING_RULE:
+        kwargs["sharding_rule"] = sharding_rule
+    cp.def_partition(**kwargs)
+    return cp
+
+
+def shape_struct(shape, dtype, *operands):
+    """`ShapeDtypeStruct` declaring the operands' vma union where supported.
+
+    On JAX without vma-typed avals this is a plain ShapeDtypeStruct — there
+    is no check_vma to satisfy there."""
+    if _HAS_VMA_STRUCT:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma_of(*operands))
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def interpret() -> bool:
@@ -27,6 +73,8 @@ def interpret() -> bool:
 
 def shard_map_interp(x) -> bool:
     """True when per-shard interpret-mode code must take the XLA fallback."""
+    if not _HAS_TYPEOF:
+        return False
     return interpret() and bool(getattr(jax.typeof(x), "vma", None))
 
 
@@ -61,6 +109,8 @@ def pad_batch(x, block):
 
 def vma_of(*arrays):
     """Union of the mesh axes the arrays vary over (empty outside
-    shard_map)."""
+    shard_map, and always empty on JAX without vma-typed avals)."""
+    if not _HAS_TYPEOF:
+        return frozenset()
     return frozenset().union(*(getattr(jax.typeof(a), "vma", frozenset())
                                for a in arrays))

@@ -62,10 +62,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpu_dp.ops._partition import (
     batch_axis as _batch_axis,
+    def_partition as _def_partition,
     interpret as _interpret,
     pad_batch as _pad_batch,
+    shape_struct as _shape_struct,
     shard_map_interp as _shard_map_interp,
-    vma_of as _vma_of,
 )
 
 _BLOCK_B = 0  # default: auto (pick images/grid-step from the VMEM budget)
@@ -224,15 +225,14 @@ def _run_local(x, w, scale, shift, residual, block_b, activate,
     # equivalent to not passing it).
     operands = (xp, w3, scale2, shift2) + (
         () if residual is None else (residual,))
-    vma = _vma_of(*operands)
-    img_shape = jax.ShapeDtypeStruct(xp.shape, x.dtype, vma=vma)
+    img_shape = _shape_struct(xp.shape, x.dtype, *operands)
     out_shape = [img_shape]
     out_specs = [img_spec]
     if emit_z:
         out_shape.append(img_shape)
         out_specs.append(img_spec)
     if emit_stats:
-        out_shape.append(jax.ShapeDtypeStruct((2, c), jnp.float32, vma=vma))
+        out_shape.append(_shape_struct((2, c), jnp.float32, *operands))
         out_specs.append(pl.BlockSpec((2, c), lambda i: (0, 0),
                                       memory_space=pltpu.VMEM))
     single_out = len(out_shape) == 1
@@ -337,8 +337,8 @@ def _make_cp(with_res, emit_z=False, emit_stats=False):
     if emit_stats:
         outs.append("u v")  # fresh factors: stats are replicated, never
         # tied to the channel factor (the partition rule psums partials)
-    cp.def_partition(partition=part, infer_sharding_from_operands=infer,
-                     sharding_rule=f"{ins} -> {', '.join(outs)}")
+    _def_partition(cp, partition=part, infer_sharding_from_operands=infer,
+                   sharding_rule=f"{ins} -> {', '.join(outs)}")
     return cp
 
 

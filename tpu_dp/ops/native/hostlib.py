@@ -18,16 +18,47 @@ _lib: ctypes.CDLL | None = None
 _build_failed = False
 
 
-def _build() -> bool:
-    cmd = [
-        "g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
-        str(_SRC), "-o", str(_LIB),
-    ]
+def _build(out: Path) -> bool:
+    """Compile hostring.cpp to ``out`` (atomic: tmp + rename, so concurrent
+    importers — e.g. spawned test workers — never load a half-written .so)."""
     try:
+        out.parent.mkdir(parents=True, exist_ok=True)
+        tmp = out.with_name(f"{out.name}.tmp.{os.getpid()}")
+        cmd = [
+            "g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+            str(_SRC), "-o", str(tmp),
+        ]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
         return True
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
         return False
+
+
+def _cached_lib_path() -> Path:
+    """Content-addressed build location outside the source tree.
+
+    Keyed on the source hash: editing hostring.cpp gets a fresh build
+    without mtime games, and a stale/incompatible prebuilt .so in the repo
+    (different glibc, different arch) never blocks a local rebuild — the
+    checkout may be read-only.
+    """
+    import hashlib
+
+    digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+    root = Path(
+        os.environ.get("TPU_DP_CACHE_DIR")
+        or os.environ.get("XDG_CACHE_HOME")
+        or Path.home() / ".cache"
+    )
+    return root / "tpu_dp" / f"libtpudp_host-{digest}.so"
+
+
+def _try_load(path: Path) -> ctypes.CDLL | None:
+    try:
+        return ctypes.CDLL(str(path))
+    except OSError:
+        return None
 
 
 def _get() -> ctypes.CDLL | None:
@@ -37,13 +68,23 @@ def _get() -> ctypes.CDLL | None:
             return _lib
         if _build_failed:
             return None
-        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
-            if not _build():
-                _build_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(str(_LIB))
-        except OSError:
+        # Prebuilt .so next to the source: use it when fresh AND loadable.
+        lib = None
+        if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+            lib = _try_load(_LIB)
+        if lib is None:
+            # Compile-on-demand into the cache dir (rebuilds when the
+            # prebuilt is stale, fails to load, or doesn't exist).
+            cached = _cached_lib_path()
+            lib = _try_load(cached) if cached.exists() else None
+            if lib is None:
+                # Cache missing OR unloadable (e.g. built on another host of
+                # an NFS home, glibc upgraded since): rebuild in place.
+                if not _build(cached):
+                    _build_failed = True  # no compiler: available() -> False
+                    return None
+                lib = _try_load(cached)
+        if lib is None:
             _build_failed = True
             return None
         lib.tpudp_cpu_count.restype = ctypes.c_int

@@ -30,10 +30,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpu_dp.ops._partition import (
     batch_axis as _batch_axis_shared,
+    def_partition as _def_partition,
     interpret as _interpret,
     pad_batch as _pad_batch,
+    shape_struct as _shape_struct,
     shard_map_interp as _shard_map_interp,
-    vma_of as _vma_of,
 )
 
 _BLOCK_B = 256  # max batch rows per grid step; (256, 128) f32 tiles fit VMEM
@@ -111,8 +112,8 @@ def _fwd_local(logits, labels):
         grid=(logits_p.shape[0] // block,),
         in_specs=[row_spec, col_spec],
         out_specs=col_spec,
-        out_shape=jax.ShapeDtypeStruct((logits_p.shape[0], 1), jnp.float32,
-                                       vma=_vma_of(logits_p, labels_p)),
+        out_shape=_shape_struct((logits_p.shape[0], 1), jnp.float32,
+                                logits_p, labels_p),
         interpret=_interpret(),
     )(logits_p, labels_p)
     return loss[:b, 0]
@@ -132,8 +133,8 @@ def _bwd_local(logits, labels, ct):
         grid=(logits_p.shape[0] // block,),
         in_specs=[row_spec, col_spec, col_spec],
         out_specs=row_spec,
-        out_shape=jax.ShapeDtypeStruct(logits_p.shape, logits.dtype,
-                                       vma=_vma_of(logits_p, labels_p, ct_p)),
+        out_shape=_shape_struct(logits_p.shape, logits.dtype,
+                                logits_p, labels_p, ct_p),
         interpret=_interpret(),
     )(logits_p, labels_p, ct_p)
     return dlogits[:b]
@@ -157,8 +158,8 @@ def _make_cp(fn, n_args, out_spec_fn, rule):
         arg_shardings = (row, vec, vec)[:n_args]
         return mesh, fn, out_spec_fn(mesh, batch), arg_shardings
 
-    cp.def_partition(partition=part, infer_sharding_from_operands=infer,
-                     sharding_rule=rule)
+    _def_partition(cp, partition=part, infer_sharding_from_operands=infer,
+                   sharding_rule=rule)
     return cp
 
 

@@ -200,6 +200,29 @@ def barrier(mesh: Mesh | None = None) -> None:
         raise RuntimeError(f"barrier psum returned {total}, expected {n}")
 
 
+def fault_tolerant_barrier(mesh: Mesh | None = None, retries: int = 2,
+                           base_delay: float = 0.05) -> None:
+    """`barrier()` with bounded retry and a typed terminal failure.
+
+    The preemption exit path (`docs/RESILIENCE.md`: signal → snapshot →
+    barrier → exit 143) must not hang on a half-dead slice, and must not
+    report an untyped error: transient coordination hiccups are retried
+    with exponential backoff; persistent failure raises
+    `tpu_dp.resilience.PeerFailedError` attributing this process.
+    """
+    from tpu_dp.resilience.retry import PeerFailedError, retry_call
+
+    try:
+        retry_call(barrier, mesh, retries=retries, base_delay=base_delay,
+                   describe="mesh barrier")
+    except Exception as e:
+        raise PeerFailedError(
+            f"barrier failed on process {jax.process_index()}/"
+            f"{jax.process_count()} after {retries + 1} attempts: {e}",
+            rank=jax.process_index(), world=jax.process_count(),
+        ) from e
+
+
 def describe(mesh: Mesh | None = None) -> dict:
     """Topology summary for startup logs and diagnostics.
 

@@ -1,0 +1,55 @@
+"""Preemption-aware fault tolerance: survive and resume host/process death.
+
+The subsystem the reference DDP tutorial entirely lacks (its training run
+dies permanently with any rank, SURVEY.md §5) and the roadmap's
+long-running multi-host scenarios require. Four pieces, composable and
+individually usable:
+
+- `snapshot` — async step-cadence snapshots of the live `TrainState`
+  (double-buffered host copy, background write, atomic commit, GC);
+- `preempt` — SIGTERM/SIGINT → final snapshot → barrier → exit 143, and
+  `resume_latest` to restore the newest complete state;
+- `retry` — bounded exponential-backoff retry + `PeerFailedError` with
+  rank attribution, wrapping the native host-ring collectives;
+- `faultinject` — deterministic kill/preempt/delay/drop injection for the
+  resilience test suite (`tests/test_resilience.py`).
+
+See docs/RESILIENCE.md for the snapshot format and the preemption/resume
+contract.
+"""
+
+from tpu_dp.resilience.faultinject import (
+    KILL_EXIT_CODE,
+    FaultInjector,
+    FaultPlan,
+)
+from tpu_dp.resilience.preempt import (
+    PREEMPTED_EXIT_CODE,
+    PreemptedError,
+    PreemptionHandler,
+    find_latest,
+    resume_latest,
+)
+from tpu_dp.resilience.retry import (
+    PeerFailedError,
+    ResilientRing,
+    backoff_delays,
+    retry_call,
+)
+from tpu_dp.resilience.snapshot import SnapshotManager
+
+__all__ = [
+    "FaultInjector",
+    "FaultPlan",
+    "KILL_EXIT_CODE",
+    "PREEMPTED_EXIT_CODE",
+    "PeerFailedError",
+    "PreemptedError",
+    "PreemptionHandler",
+    "ResilientRing",
+    "SnapshotManager",
+    "backoff_delays",
+    "find_latest",
+    "resume_latest",
+    "retry_call",
+]

@@ -1,0 +1,138 @@
+"""Deterministic fault injection for resilience testing.
+
+Real preemptions and host deaths are non-deterministic; proving the
+snapshot/resume path correct needs the opposite — a fault that fires at
+exactly the same optimizer step on exactly the same rank every run, so a
+killed run and its resumed continuation can be compared bitwise against an
+uninterrupted one (`tests/test_resilience.py`). The injector is consulted
+by the `Trainer` at step boundaries and by `ResilientRing` before each
+collective; in production it is simply never constructed.
+
+Spec grammar (``resilience.fault`` config field or ``TPU_DP_FAULT`` env,
+the latter so spawned worker processes inherit the plan)::
+
+    kill:step=13             # os._exit(137) at the first step boundary >= 13
+    kill:step=13,rank=1      # only on process 1 (default: every rank)
+    preempt:step=9           # deliver SIGTERM to self (exercises the hook)
+    delay:step=5,ms=250      # sleep 250ms once (straggler simulation)
+    drop:step=7              # arm a one-shot collective drop (ring retry path)
+
+With multi-step windows the host observes step counts only at window
+boundaries, so "at step K" means the first boundary where the global step
+reached K — deterministic for a fixed window size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import time
+
+logger = logging.getLogger(__name__)
+
+_KINDS = ("kill", "preempt", "delay", "drop")
+#: exit code for an injected hard kill — SIGKILL's 128+9, the signature of
+#: a host OOM-killer / preemption-without-grace death.
+KILL_EXIT_CODE = 137
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    kind: str          # kill | preempt | delay | drop
+    step: int          # global optimizer step the fault fires at (>=)
+    rank: int = -1     # -1: every rank
+    delay_ms: float = 0.0
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan | None":
+        """Parse ``kind:key=val,key=val``; empty/None spec → no plan."""
+        spec = (spec or "").strip()
+        if not spec:
+            return None
+        kind, _, rest = spec.partition(":")
+        if kind not in _KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in {spec!r}; "
+                f"expected one of {_KINDS}"
+            )
+        fields: dict[str, float] = {}
+        for item in filter(None, rest.split(",")):
+            key, eq, val = item.partition("=")
+            if not eq or key not in ("step", "rank", "ms"):
+                raise ValueError(f"bad fault field {item!r} in {spec!r}")
+            fields[key] = float(val)
+        if "step" not in fields:
+            raise ValueError(f"fault spec {spec!r} needs step=<n>")
+        return cls(
+            kind=kind,
+            step=int(fields["step"]),
+            rank=int(fields.get("rank", -1)),
+            delay_ms=float(fields.get("ms", 0.0)),
+        )
+
+
+class FaultInjector:
+    """Fires a :class:`FaultPlan` exactly once at its step boundary."""
+
+    def __init__(self, plan: FaultPlan, rank: int = 0):
+        self.plan = plan
+        self.rank = int(rank)
+        self.fired = False
+        self._drop_armed = False
+
+    @classmethod
+    def from_spec(cls, spec: str, rank: int = 0) -> "FaultInjector | None":
+        """Injector from a spec string (or the TPU_DP_FAULT env fallback)."""
+        spec = spec or os.environ.get("TPU_DP_FAULT", "")
+        plan = FaultPlan.parse(spec)
+        if plan is None:
+            return None
+        return cls(plan, rank=rank)
+
+    def _due(self, global_step: int) -> bool:
+        if self.fired:
+            return False
+        if self.plan.rank >= 0 and self.plan.rank != self.rank:
+            return False
+        return global_step >= self.plan.step
+
+    def on_step(self, global_step: int) -> None:
+        """Trainer hook: fire the plan if its step boundary was reached.
+
+        ``kill`` never returns (`os._exit` — no atexit, no flushes, the
+        honest simulation of a yanked host). The other kinds return after
+        their side effect.
+        """
+        if not self._due(global_step):
+            return
+        self.fired = True
+        plan = self.plan
+        if plan.kind == "kill":
+            logger.warning(
+                "fault injection: killing rank %d at step %d (exit %d)",
+                self.rank, global_step, KILL_EXIT_CODE,
+            )
+            os._exit(KILL_EXIT_CODE)
+        elif plan.kind == "preempt":
+            logger.warning(
+                "fault injection: SIGTERM to self (rank %d) at step %d",
+                self.rank, global_step,
+            )
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif plan.kind == "delay":
+            logger.warning(
+                "fault injection: delaying rank %d for %.0fms at step %d",
+                self.rank, plan.delay_ms, global_step,
+            )
+            time.sleep(plan.delay_ms / 1000.0)
+        elif plan.kind == "drop":
+            self._drop_armed = True
+
+    def take_drop(self) -> bool:
+        """Consume the one-shot armed collective drop (ResilientRing hook)."""
+        if self._drop_armed:
+            self._drop_armed = False
+            return True
+        return False

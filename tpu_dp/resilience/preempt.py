@@ -1,0 +1,154 @@
+"""Preemption handling: signal → final snapshot → barrier → exit 143.
+
+TPU fleets evict with a SIGTERM and a grace window; the reference (and the
+seed `Trainer`) would just die, losing everything since the last epoch
+checkpoint. The contract here (docs/RESILIENCE.md):
+
+1. SIGTERM/SIGINT sets a flag — handlers never do real work, signal
+   context is too restricted for JAX/IO;
+2. the `Trainer` polls the flag at step-window boundaries, takes a final
+   snapshot, and joins it (async write completes before exit);
+3. a cross-process barrier keeps fast ranks from tearing down the
+   coordination service while slow ranks still dispatch collectives;
+4. :class:`PreemptedError` propagates out of `fit()`; `train.py` maps it
+   to **exit code 143** (128 + SIGTERM), the conventional
+   "terminated-by-request" status cluster managers treat as
+   non-failure.
+
+`resume_latest` is the other half: pick the newest complete state across
+the epoch-checkpoint dir and the snapshot dir, so an auto-restarted job
+continues from wherever it actually got to.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from pathlib import Path
+from typing import Any
+
+from tpu_dp import checkpoint as ckpt_lib
+
+logger = logging.getLogger(__name__)
+
+#: 128 + SIGTERM — the exit status of a graceful preemption shutdown.
+PREEMPTED_EXIT_CODE = 143
+
+
+class PreemptedError(RuntimeError):
+    """Raised out of the training loop after a clean preemption shutdown."""
+
+    exit_code = PREEMPTED_EXIT_CODE
+
+
+class PreemptionHandler:
+    """Install SIGTERM/SIGINT flag-setters for the lifetime of a `with`.
+
+    Repeated signals stay flag-only (the trainer finishes its in-flight
+    window, snapshots, and exits — a second SIGTERM must not corrupt the
+    final write). Handlers only install on the main thread (CPython
+    restriction); elsewhere the handler degrades to a never-set flag.
+    Previous handlers are restored on exit.
+    """
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._prev: dict[int, Any] = {}
+        self._installed = False
+        self.last_signal: int | None = None
+
+    @property
+    def requested(self) -> bool:
+        """True once a preemption signal arrived."""
+        return self._event.is_set()
+
+    def _handle(self, signum, frame):
+        self.last_signal = signum
+        self._event.set()
+        logger.warning(
+            "preemption signal %s received — snapshotting at the next step "
+            "boundary, then exiting %d",
+            signal.Signals(signum).name, PREEMPTED_EXIT_CODE,
+        )
+
+    def install(self) -> "PreemptionHandler":
+        if self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            logger.warning(
+                "preemption handler not installed (not on the main thread)"
+            )
+            return self
+        for sig in self.SIGNALS:
+            self._prev[sig] = signal.signal(sig, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev.clear()
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+
+
+def _manager_step(step_dir: Path) -> int:
+    """Global step encoded in a manager ``step_<n>`` directory name."""
+    return int(step_dir.name.split("_")[1])
+
+
+def find_latest(ckpt_dir: str | Path,
+                snapshot_dir: str | Path | None = None
+                ) -> tuple[Path, int] | None:
+    """Newest complete state across checkpoints and snapshots.
+
+    Returns ``(dir, global_step)`` of the highest-step complete save, or
+    None when there is nothing to resume from. Epoch checkpoints win ties
+    (same step ⇒ same state; the epoch layout resumes at a clean epoch
+    start). The flat pre-manager layout (``<ckpt_dir>/state.msgpack``) is
+    the fallback of last resort — it predates step numbering.
+    """
+    candidates: list[tuple[int, int, Path]] = []  # (step, priority, dir)
+    ckpt_latest = ckpt_lib.CheckpointManager(ckpt_dir).latest_dir()
+    if ckpt_latest is not None:
+        candidates.append((_manager_step(ckpt_latest), 1, ckpt_latest))
+    if snapshot_dir is not None:
+        snap_latest = ckpt_lib.CheckpointManager(snapshot_dir).latest_dir()
+        if snap_latest is not None:
+            candidates.append((_manager_step(snap_latest), 0, snap_latest))
+    if candidates:
+        step, _, best = max(candidates, key=lambda c: (c[0], c[1]))
+        return best, step
+    if ckpt_lib.checkpoint_exists(ckpt_dir):
+        return Path(ckpt_dir), -1
+    return None
+
+
+def resume_latest(target, ckpt_dir: str | Path,
+                  snapshot_dir: str | Path | None = None):
+    """Restore the newest state; returns ``(state, meta, source_dir)``.
+
+    ``meta["kind"] == "snapshot"`` marks a mid-epoch resume point — the
+    caller fast-forwards the sampler by ``meta["steps_done"]``; an epoch
+    checkpoint resumes at epoch ``meta["epoch"] + 1``, step 0.
+    Raises FileNotFoundError when there is nothing to resume from.
+    """
+    found = find_latest(ckpt_dir, snapshot_dir)
+    if found is None:
+        raise FileNotFoundError(
+            f"nothing to resume from under {ckpt_dir}"
+            + (f" or {snapshot_dir}" if snapshot_dir else "")
+        )
+    source, _ = found
+    state, meta = ckpt_lib.load_checkpoint(source, target)
+    return state, meta, source

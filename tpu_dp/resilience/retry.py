@@ -1,0 +1,183 @@
+"""Bounded retry with exponential backoff + typed peer-failure errors.
+
+The reference has no failure handling at all (SURVEY.md §5): a dead rank
+hangs every survivor inside its next NCCL collective. The native TCP ring
+(`tpu_dp.ops.native.hostlib`) already turns peer death into a fast
+`RuntimeError`, but an untyped error with no rank attribution is hard to
+act on — the trainer can't tell "rank 2's host died, requeue it" from
+"my own socket hiccuped, try again". This module adds the policy layer:
+
+- :func:`retry_call` — one generic bounded-retry loop (exponential
+  backoff, deterministic delays — no jitter, so tests and multi-rank
+  logs line up);
+- :class:`PeerFailedError` — the typed terminal error every resilient
+  wrapper raises after retries are exhausted, carrying the local rank,
+  world size, and the suspect peer ranks;
+- :class:`ResilientRing` — the host-ring collectives of
+  `hostlib.Ring` wrapped per-call: transient socket errors (and
+  injected drops from `tpu_dp.resilience.faultinject`) are retried with
+  backoff; persistent failure raises `PeerFailedError` naming the ring
+  neighbors whose death is the usual cause.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class PeerFailedError(RuntimeError):
+    """A collective failed because a peer process is gone (or unreachable).
+
+    Carries enough attribution for a supervisor to act: which rank saw the
+    failure, the world size, and which peer ranks are suspect (for a ring,
+    the immediate neighbors — the only ranks this process talks to).
+    """
+
+    def __init__(self, message: str, *, rank: int | None = None,
+                 world: int | None = None,
+                 suspect_ranks: Sequence[int] = ()):
+        super().__init__(message)
+        self.rank = rank
+        self.world = world
+        self.suspect_ranks = tuple(suspect_ranks)
+
+
+def backoff_delays(retries: int, base_delay: float = 0.05,
+                   max_delay: float = 2.0) -> list[float]:
+    """The deterministic delay schedule retry_call sleeps through."""
+    return [min(max_delay, base_delay * (2.0 ** i)) for i in range(retries)]
+
+
+def retry_call(
+    fn: Callable[..., Any],
+    *args: Any,
+    retries: int = 3,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    retry_on: tuple[type[BaseException], ...] = (RuntimeError, OSError),
+    describe: str = "",
+    sleep: Callable[[float], None] = time.sleep,
+    **kwargs: Any,
+) -> Any:
+    """Call ``fn`` with up to ``retries`` retries and exponential backoff.
+
+    ``retries`` counts *re*-tries: the function runs at most
+    ``retries + 1`` times. Only ``retry_on`` exceptions are retried;
+    anything else propagates immediately (a typed `PeerFailedError` from a
+    nested resilient call is terminal by design — re-wrapping it in more
+    retries would just multiply timeouts). The final failure re-raises the
+    last exception; callers that want rank attribution catch it and raise
+    `PeerFailedError` with their topology context.
+    """
+    name = describe or getattr(fn, "__name__", repr(fn))
+    delays = backoff_delays(retries, base_delay, max_delay)
+    last: BaseException | None = None
+    for attempt in range(retries + 1):
+        try:
+            return fn(*args, **kwargs)
+        except PeerFailedError:
+            raise  # already terminal + attributed
+        except retry_on as e:
+            last = e
+            if attempt == retries:
+                break
+            logger.warning(
+                "%s failed (attempt %d/%d): %s — retrying in %.3fs",
+                name, attempt + 1, retries + 1, e, delays[attempt],
+            )
+            sleep(delays[attempt])
+    raise last  # type: ignore[misc]
+
+
+class ResilientRing:
+    """`hostlib.Ring` with bounded-retry collectives and typed failures.
+
+    Construction retries the TCP rendezvous itself (ranks of a preempted
+    pod restart seconds apart; a one-shot rendezvous would turn every
+    staggered restart into a failed launch). Each collective retries
+    transient errors with backoff, then raises :class:`PeerFailedError`
+    attributing the ring neighbors. An optional
+    `tpu_dp.resilience.faultinject.FaultInjector` lets tests drop exactly
+    one collective deterministically.
+    """
+
+    #: collectives forwarded with the retry wrapper
+    _OPS = ("allreduce", "broadcast", "allgather", "reduce_scatter",
+            "reduce", "send_next", "recv_prev", "exchange", "shift",
+            "barrier")
+
+    def __init__(self, host: str, base_port: int, rank: int, world: int,
+                 timeout_ms: int = 10_000, retries: int = 2,
+                 base_delay: float = 0.05, max_delay: float = 2.0,
+                 injector=None):
+        from tpu_dp.ops.native.hostlib import Ring
+
+        self.rank = int(rank)
+        self.world = int(world)
+        self.retries = int(retries)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self._injector = injector
+        try:
+            self._ring = retry_call(
+                Ring, host, base_port, rank, world, timeout_ms,
+                retries=retries, base_delay=base_delay, max_delay=max_delay,
+                describe=f"ring rendezvous (rank {rank}/{world})",
+            )
+        except (RuntimeError, OSError) as e:
+            raise PeerFailedError(
+                f"ring rendezvous failed on rank {rank}/{world} after "
+                f"{retries + 1} attempts: {e}",
+                rank=rank, world=world,
+                suspect_ranks=self._neighbors(),
+            ) from e
+
+    def _neighbors(self) -> tuple[int, ...]:
+        if self.world <= 1:
+            return ()
+        prev, nxt = (self.rank - 1) % self.world, (self.rank + 1) % self.world
+        return (prev,) if prev == nxt else (prev, nxt)
+
+    def _call(self, op: str, *args, **kwargs):
+        def attempt():
+            if self._injector is not None and self._injector.take_drop():
+                raise RuntimeError(
+                    f"fault injection: dropped collective {op!r} "
+                    f"on rank {self.rank}"
+                )
+            return getattr(self._ring, op)(*args, **kwargs)
+
+        try:
+            return retry_call(
+                attempt, retries=self.retries, base_delay=self.base_delay,
+                max_delay=self.max_delay,
+                describe=f"ring {op} (rank {self.rank}/{self.world})",
+            )
+        except (RuntimeError, OSError) as e:
+            raise PeerFailedError(
+                f"ring {op} failed on rank {self.rank}/{self.world} after "
+                f"{self.retries + 1} attempts ({e}); suspect peer rank(s) "
+                f"{list(self._neighbors())} dead or unreachable",
+                rank=self.rank, world=self.world,
+                suspect_ranks=self._neighbors(),
+            ) from e
+
+    def __getattr__(self, name: str):
+        # Only reached for names not found on the instance/class: forward
+        # collectives through the retry wrapper, everything else raw.
+        if name in self._OPS:
+            return lambda *a, **kw: self._call(name, *a, **kw)
+        return getattr(self._ring, name)
+
+    def close(self) -> None:
+        self._ring.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

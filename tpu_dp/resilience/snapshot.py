@@ -1,0 +1,128 @@
+"""Async training snapshots — checkpoint cadence measured in steps, not epochs.
+
+The `Trainer` writes one checkpoint per epoch (`checkpoint.CheckpointManager`
+in `fit()`); on a preemptible fleet that loses up to a full epoch of work per
+eviction. This layer snapshots the live `TrainState` every
+``snapshot_every_steps`` optimizer steps with (almost) no step-time cost:
+
+- the device→host copy lands in one of two **reusable host buffers**
+  (double buffering: while the writer thread serializes buffer A to disk,
+  the next snapshot copies into buffer B — no allocation churn, no wait on
+  the disk);
+- serialization + IO run on the manager's background thread
+  (`CheckpointManager(async_save=True)`), commit is atomic
+  (tmp + rename, then the ``latest`` pointer), and retention GC keeps the
+  newest ``keep`` snapshots;
+- snapshots live in their own directory (default
+  ``<ckpt_dir>/snapshots``) so the epoch-checkpoint retention policy and
+  the step-snapshot retention policy never fight over the same files.
+
+Snapshot metadata records the mid-epoch position (``epoch``,
+``steps_done``) so `Trainer._maybe_resume` can fast-forward the
+`ShardedSampler` and replay/skip no batch.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+from tpu_dp.checkpoint import CheckpointManager
+
+
+class SnapshotManager:
+    """Step-cadence async snapshots of `TrainState` with double buffering.
+
+    ``every_steps <= 0`` disables the cadence (``maybe()`` never fires) but
+    the manager still serves explicit ``snapshot()`` calls — the
+    preemption hook's final snapshot works even with periodic
+    snapshotting off.
+    """
+
+    def __init__(self, snap_dir: str | os.PathLike, every_steps: int = 0,
+                 keep: int = 2):
+        self.snap_dir = Path(snap_dir)
+        self.every_steps = int(every_steps)
+        self.keep = int(keep)
+        self._mgr = CheckpointManager(self.snap_dir, keep=keep,
+                                      async_save=True)
+        # Two host-buffer slots; _host_copy alternates. Slot discipline:
+        # by the time a slot comes around again, the write that used it has
+        # been joined by the interleaved save() (which waits for the
+        # previous in-flight write before starting the next).
+        self._buffers: list[list[np.ndarray] | None] = [None, None]
+        self._slot = 0
+        self._last_step = -1
+
+    def _host_copy(self, state):
+        """Device→host copy of ``state`` into the next reusable buffer."""
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        slot = self._slot
+        self._slot ^= 1
+        buf = self._buffers[slot]
+        if buf is None:
+            buf = [np.array(x) for x in leaves]
+            self._buffers[slot] = buf
+        else:
+            for dst, src in zip(buf, leaves):
+                np.copyto(dst, np.asarray(src))
+        return jax.tree_util.tree_unflatten(treedef, buf)
+
+    def due(self, global_step: int) -> bool:
+        """True when ``global_step`` crossed a cadence boundary.
+
+        Crossing, not equality: with multi-step windows the host sees steps
+        only at window boundaries, so cadence 50 with 24-step windows fires
+        at 72, 120, … — every boundary past a multiple of 50.
+        """
+        if self.every_steps <= 0:
+            return False
+        prev = self._last_step if self._last_step >= 0 else 0
+        return global_step // self.every_steps > prev // self.every_steps
+
+    def maybe(self, state, global_step: int,
+              meta: dict[str, Any] | None = None) -> Path | None:
+        """Snapshot iff the cadence is due; returns the path when taken."""
+        if not self.due(global_step):
+            return None
+        return self.snapshot(state, global_step, meta)
+
+    def snapshot(self, state, global_step: int,
+                 meta: dict[str, Any] | None = None) -> Path | None:
+        """Unconditional snapshot of ``state`` at ``global_step``.
+
+        The host copy happens NOW (synchronous, overlapping any in-flight
+        disk write of the other buffer); serialization + IO are async.
+        Process-0-only like the underlying manager.
+        """
+        self._last_step = int(global_step)
+        if jax.process_index() != 0:
+            return None
+        host_state = self._host_copy(state)
+        meta = dict(meta or {})
+        meta.setdefault("kind", "snapshot")
+        meta["global_step"] = int(global_step)
+        return self._mgr.save(state, meta, step=int(global_step),
+                              host_state=host_state)
+
+    def latest_dir(self) -> Path | None:
+        return self._mgr.latest_dir()
+
+    def restore(self, target):
+        return self._mgr.restore(target)
+
+    def wait(self) -> None:
+        self._mgr.wait()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

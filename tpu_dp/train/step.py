@@ -67,7 +67,29 @@ def _to_varying(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     """
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis_name, to="varying")
-    return jax.lax.pvary(x, axis_name)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis_name)
+    # Pre-vma JAX: no replication typing, nothing to cast (the shard_map
+    # below runs with check_rep=False, so AD never inserts implicit psums).
+    return x
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """`jax.shard_map` across JAX versions.
+
+    Older JAX only has `jax.experimental.shard_map.shard_map`; its
+    replication-checking rewrite would insert the implicit grad psums the
+    varying-params cast in `_to_varying` exists to avoid, so it runs
+    unchecked there — the explicit collectives make every output replicated
+    before it crosses the shard_map boundary either way.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
 
 
 def _maybe_normalize(images: jnp.ndarray) -> jnp.ndarray:
@@ -493,7 +515,7 @@ def make_train_step_shard_map(
     # Replication checking stays ON: an output that is rank-varying (a
     # forgotten pmean/psum on a new metric) is a trace-time error instead of
     # a silent wrong answer from device 0's shard.
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         local_step,
         mesh=mesh,
         in_specs=(repl_spec, batch_spec),

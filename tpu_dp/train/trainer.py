@@ -154,7 +154,9 @@ class Trainer:
         # trajectory is identical to the streaming path (same sampler
         # order, same step body — equivalence-tested); what changes is the
         # host work per step: ~KB of int32 instead of a ~MB gather+copy.
-        self.resident_train = None
+        # Staging is lazy (`resident_train` property): eval-only or tooling
+        # constructions never pay the host→HBM transfer (ADVICE r5).
+        self._resident_train = None
         self._resident_loops: dict[int, Any] = {}
         mode = cfg.data.device_resident
         if mode not in ("auto", "on", "off"):
@@ -165,25 +167,65 @@ class Trainer:
             raise ValueError(
                 "data.device_resident=on requires data.drop_remainder=true"
             )
-        if mode == "on" or (
+        if mode == "on":
+            ds_bytes = self.train_pipe.dataset_bytes()
+            if ds_bytes > cfg.data.resident_max_bytes:
+                # Forced on is explicit user intent — warn with the numbers
+                # (instead of the opaque allocator error staging would hit
+                # on a dataset that genuinely exceeds HBM) and proceed.
+                log0(
+                    "warning: data.device_resident=on stages %d bytes, over "
+                    "data.resident_max_bytes=%d — staging may exhaust "
+                    "device memory; raise the budget or use auto",
+                    ds_bytes, cfg.data.resident_max_bytes,
+                )
+        self._resident_enabled = mode == "on" or (
             mode == "auto"
             and cfg.data.drop_remainder
             and self.train_pipe.dataset_bytes() <= cfg.data.resident_max_bytes
-        ):
-            self.resident_train = self.train_pipe.resident_data()
+        )
 
         rng = jax.random.PRNGKey(cfg.train.seed)
         sample = np.zeros((1, 32, 32, 3), np.float32)
         self.state = create_train_state(self.model, rng, sample, self.optimizer)
         self.start_epoch = 0
+        self.start_step = 0  # step within start_epoch (mid-epoch resume)
         self.meter = ThroughputMeter(warmup_steps=2)
 
         self.ckpt_mgr = ckpt_lib.CheckpointManager(
             cfg.train.ckpt_dir, keep=cfg.train.ckpt_keep,
             async_save=cfg.train.ckpt_async,
         )
+
+        # Resilience (tpu_dp/resilience/, docs/RESILIENCE.md): async
+        # step-cadence snapshots, SIGTERM/SIGINT preemption hook, and
+        # deterministic fault injection for the test suite. The snapshot
+        # manager always exists — with snapshot_every_steps=0 the cadence
+        # never fires, but the preemption hook's final snapshot still has
+        # somewhere to land.
+        from tpu_dp.resilience import (
+            FaultInjector,
+            PreemptionHandler,
+            SnapshotManager,
+        )
+
+        res = cfg.resilience
+        self.snapshot_dir = res.snapshot_dir or str(
+            Path(cfg.train.ckpt_dir) / "snapshots"
+        )
+        self.snap_mgr = SnapshotManager(
+            self.snapshot_dir, every_steps=res.snapshot_every_steps,
+            keep=res.snapshot_keep,
+        )
+        self.preempt = PreemptionHandler() if res.handle_signals else None
+        self.fault = FaultInjector.from_spec(
+            res.fault, rank=self.ctx.process_index
+        )
         if cfg.train.resume:
             self._maybe_resume()
+        # Host-side mirror of state.step: the snapshot cadence and fault
+        # steps key off it without a per-window device sync.
+        self._host_step = int(self.state.step)
 
     def _load_data(self, cfg: Config) -> None:
         """Process 0 materializes the dataset first; the rest then read it.
@@ -219,25 +261,47 @@ class Trainer:
         if self.ctx.process_index != 0:
             self.train_ds, self.test_ds = _load()
 
-    def _maybe_resume(self) -> None:
-        """Resume from checkpoint, agreed across processes.
+    def _resume_position(self, meta: dict) -> tuple[int, int]:
+        """(start_epoch, start_step) a restored state's meta encodes.
 
-        Checkpoints are written by process 0 only; on a pod each host has
-        its own disk, so the resume decision and the restored state must
-        come from process 0 (otherwise replicas desync: some resume, some
-        start fresh).
+        Epoch checkpoints record the *finished* epoch → resume at the next
+        one, step 0. Snapshots record the mid-epoch position → resume the
+        same epoch and fast-forward the sampler by ``steps_done`` (no batch
+        replayed, none skipped). A snapshot taken at the exact epoch end
+        normalizes to (epoch+1, 0).
+        """
+        if meta.get("kind") == "snapshot":
+            epoch = int(meta.get("epoch", 0))
+            step = int(meta.get("steps_done", 0))
+            spe = len(self.train_pipe)
+            if spe and step >= spe:
+                return epoch + 1, 0
+            return epoch, step
+        return int(meta.get("epoch", -1)) + 1, 0
+
+    def _maybe_resume(self) -> None:
+        """Resume from the newest checkpoint OR snapshot, agreed across
+        processes.
+
+        Checkpoints/snapshots are written by process 0 only; on a pod each
+        host has its own disk, so the resume decision and the restored
+        state must come from process 0 (otherwise replicas desync: some
+        resume, some start fresh). The newest complete save wins across
+        both layouts (`tpu_dp.resilience.find_latest`), so a run killed
+        mid-epoch resumes from its last step snapshot, not the last epoch
+        boundary.
         """
         cfg = self.cfg
-        # Newest manager checkpoint, else the flat pre-manager layout.
-        resume_dir = self.ckpt_mgr.latest_dir()
-        if resume_dir is None and ckpt_lib.checkpoint_exists(cfg.train.ckpt_dir):
-            resume_dir = cfg.train.ckpt_dir
+        from tpu_dp.resilience import find_latest
+
+        found = find_latest(cfg.train.ckpt_dir, self.snapshot_dir)
+        resume_dir = found[0] if found is not None else None
         exists = resume_dir is not None
         if self.ctx.process_count == 1:
             if not exists:
                 return
             self.state, meta = ckpt_lib.load_checkpoint(resume_dir, self.state)
-            self.start_epoch = int(meta.get("epoch", -1)) + 1
+            self.start_epoch, self.start_step = self._resume_position(meta)
         else:
             from jax.experimental import multihost_utils
 
@@ -248,14 +312,28 @@ class Trainer:
                 return
             if self.ctx.process_index == 0:
                 state, meta = ckpt_lib.load_checkpoint(resume_dir, self.state)
-                epoch = np.int32(int(meta.get("epoch", -1)))
+                epoch, step = self._resume_position(meta)
+                pos = np.asarray([epoch, step], np.int32)
             else:
-                state, epoch = self.state, np.int32(-1)
+                state, pos = self.state, np.zeros(2, np.int32)
             host_state = jax.tree_util.tree_map(np.asarray, state)
             self.state = multihost_utils.broadcast_one_to_all(host_state)
-            self.start_epoch = int(multihost_utils.broadcast_one_to_all(epoch)) + 1
-        log0("resumed from %s at epoch %d (step %d)",
-             resume_dir, self.start_epoch, int(self.state.step))
+            pos = multihost_utils.broadcast_one_to_all(pos)
+            self.start_epoch, self.start_step = int(pos[0]), int(pos[1])
+        log0("resumed from %s at epoch %d step-in-epoch %d (global step %d)",
+             resume_dir, self.start_epoch, self.start_step,
+             int(self.state.step))
+
+    @property
+    def resident_train(self):
+        """The device-resident train set, staged on first access (or None).
+
+        Lazy so a Trainer built for eval/tooling never pays the host→HBM
+        transfer (ADVICE r5); `train_epoch` touches it on its first window.
+        """
+        if self._resident_enabled and self._resident_train is None:
+            self._resident_train = self.train_pipe.resident_data()
+        return self._resident_train
 
     @property
     def global_batch_size(self) -> int:
@@ -280,18 +358,28 @@ class Trainer:
             self._resident_loops[n] = loop
         return loop
 
-    def train_epoch(self, epoch: int) -> dict[str, float]:
+    def train_epoch(self, epoch: int, start_step: int = 0) -> dict[str, float]:
+        """One epoch of training; ``start_step`` resumes it mid-way.
+
+        ``start_step > 0`` (a snapshot resume) fast-forwards the sampler:
+        the epoch's first ``start_step`` batches were already consumed by
+        the run being resumed, so iteration starts at exactly the next one
+        — no batch replayed, none skipped.
+        """
         cfg = self.cfg
         self.train_pipe.set_epoch(epoch)  # `cifar_example_ddp.py:92` parity
         gbs = self.global_batch_size
         run_loss, run_steps = None, 0  # device-side running-loss accumulator
         ep_loss = ep_correct = None
         ep_steps, ep_count = 0, 0
-        i = -1
+        i = start_step - 1
+        done = start_step  # steps of this epoch completed (snapshot meta)
         if self.resident_train is not None:
-            items = self.train_pipe.index_windows(self.steps_per_call)
+            items = self.train_pipe.index_windows(
+                self.steps_per_call, skip_steps=start_step)
         else:
-            items = self.train_pipe.windows(self.steps_per_call)
+            items = self.train_pipe.windows(
+                self.steps_per_call, skip_steps=start_step)
         def _unstack(stacked, n):
             # Lazy per-step views over the window's stacked metrics — still
             # no host sync outside log boundaries.
@@ -335,12 +423,73 @@ class Trainer:
                     print0("[%d, %5d] loss: %.3f"
                            % (epoch + 1, i + 1, float(run_loss) / run_steps))
                     run_loss, run_steps = None, 0
+            # Resilience hooks, once per dispatched window (the host-side
+            # step boundary): async snapshot on cadence, then fault
+            # injection (tests), then the preemption flag check.
+            done += n
+            self._host_step += n
+            if self.snap_mgr.due(self._host_step):
+                # Meta (a full Config.to_dict) is built only when a snapshot
+                # actually fires — not on every window of the host hot loop.
+                self.snap_mgr.snapshot(
+                    self.state, self._host_step, self._snapshot_meta(epoch, done)
+                )
+            if self.fault is not None:
+                self.fault.on_step(self._host_step)
+            if self.preempt is not None and self.preempt.requested:
+                self._preempt_exit(epoch, done)
         stats = {
             "loss": float(ep_loss) / max(1, ep_steps) if ep_steps else 0.0,
             "accuracy": float(ep_correct) / ep_count if ep_count else 0.0,
         }
+        if start_step:
+            # A resumed epoch's accumulators cover only its post-resume
+            # tail; label the record so loss curves explain their own
+            # discontinuity instead of faking full-epoch coverage.
+            stats["resumed_at_step"] = start_step
         self.meter.mark()  # fence: epoch stats fetched, device drained
         return stats
+
+    def _snapshot_meta(self, epoch: int, steps_done: int) -> dict[str, Any]:
+        """Snapshot metadata: the mid-epoch resume position + provenance."""
+        return {
+            "kind": "snapshot",
+            "epoch": epoch,
+            "steps_done": steps_done,
+            "config": self.cfg.to_dict(),
+            "seed": self.cfg.train.seed,
+        }
+
+    def _preempt_exit(self, epoch: int, steps_done: int) -> None:
+        """The preemption contract: final snapshot → barrier → exit 143.
+
+        The snapshot is joined (not just dispatched) before the barrier, so
+        by the time any rank exits, rank 0's final state is committed and
+        an auto-restart (`--resume=auto`) loses zero steps.
+        """
+        from tpu_dp.resilience import PreemptedError
+
+        log0("preemption: taking final snapshot at epoch %d step %d "
+             "(global step %d)", epoch, steps_done, self._host_step)
+        self.snap_mgr.snapshot(
+            self.state, self._host_step, self._snapshot_meta(epoch, steps_done)
+        )
+        self.snap_mgr.wait()
+        try:
+            res = self.cfg.resilience
+            dist.fault_tolerant_barrier(
+                self.mesh, retries=res.max_retries,
+                base_delay=res.retry_base_delay_s,
+            )
+        except Exception:
+            # A half-dead slice must not block the survivors' clean exit —
+            # the snapshot is already committed.
+            log0("preemption barrier failed; exiting anyway", exc_info=True)
+        raise PreemptedError(
+            f"preempted at epoch {epoch}, step-in-epoch {steps_done} "
+            f"(global step {self._host_step}); snapshot committed to "
+            f"{self.snapshot_dir}"
+        )
 
     def _log_metrics(self, record: dict) -> None:
         """Append a JSON line to <ckpt_dir>/metrics.jsonl (process 0 only).
@@ -392,9 +541,14 @@ class Trainer:
         t0 = time.perf_counter()
         history = []
         try:
+            if self.preempt is not None:
+                self.preempt.install()
             with profile_trace(cfg.train.profile_dir):
                 for epoch in range(self.start_epoch, cfg.train.epochs):
-                    stats = self.train_epoch(epoch)
+                    start_step = (
+                        self.start_step if epoch == self.start_epoch else 0
+                    )
+                    stats = self.train_epoch(epoch, start_step=start_step)
                     history.append(stats)
                     log0("epoch %d: train loss %.4f acc %.4f (%.1f img/s)",
                          epoch + 1, stats["loss"], stats["accuracy"],
@@ -412,6 +566,10 @@ class Trainer:
                         ev = self.evaluate()
                         log0("epoch %d: eval loss %.4f acc %.4f",
                              epoch + 1, ev["loss"], ev["accuracy"])
+                    # A signal that lands between epochs (or during eval)
+                    # still gets the snapshot-and-exit-143 contract.
+                    if self.preempt is not None and self.preempt.requested:
+                        self._preempt_exit(epoch + 1, 0)
         finally:
             # Join any in-flight async write even when training aborts —
             # the freshest checkpoint is exactly what a crash-restart needs.
@@ -429,6 +587,15 @@ class Trainer:
                     raise
                 log0("checkpoint write failed during abort (original "
                      "exception propagates)", exc_info=True)
+            try:
+                self.snap_mgr.close()
+            except RuntimeError:
+                if not propagating:
+                    raise
+                log0("snapshot write failed during abort (original "
+                     "exception propagates)", exc_info=True)
+            if self.preempt is not None:
+                self.preempt.uninstall()
         print0("Finished Training")  # `cifar_example.py:90` parity
         wall = time.perf_counter() - t0
 

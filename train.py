@@ -14,15 +14,20 @@ Usage:
     python train.py --data.dataset=synthetic --train.log_every=50
     python train.py --config=checkpoints/step_0000000042/meta.json \
         --train.ckpt_dir=./repro   # reproduce into a fresh checkpoint dir
+    python train.py --resume=auto  # continue from the newest checkpoint or
+                                   # snapshot if one exists, else start fresh
 
 Any config field is overridable as `--section.field=value` (see
-`tpu_dp/config.py`).
+`tpu_dp/config.py`). Preemption (SIGTERM/SIGINT) snapshots and exits with
+code 143; an auto-restarting supervisor that relaunches with
+`--resume=auto` loses no steps (docs/RESILIENCE.md).
 """
 
 import json
 import sys
 
 from tpu_dp.config import parse_cli
+from tpu_dp.resilience import PreemptedError
 from tpu_dp.train.trainer import Trainer
 from tpu_dp.utils import print0
 
@@ -30,7 +35,14 @@ from tpu_dp.utils import print0
 def main(argv=None) -> int:
     cfg = parse_cli(sys.argv[1:] if argv is None else argv)
     trainer = Trainer(cfg)
-    result = trainer.fit()
+    try:
+        result = trainer.fit()
+    except PreemptedError as e:
+        # Clean preemption: the final snapshot is committed; exit with the
+        # conventional terminated-by-SIGTERM status so supervisors restart
+        # (with --resume=auto) instead of flagging a failure.
+        print0(f"preempted: {e}")
+        return PreemptedError.exit_code
     summary = {
         "model": cfg.model.name,
         "dataset": trainer.train_ds.name,
