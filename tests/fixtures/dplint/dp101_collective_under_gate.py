@@ -1,0 +1,17 @@
+"""Adversarial dplint fixture — DP101: collective under a rank gate.
+
+Only rank 0 reaches the psum; every other rank blocks in it forever the
+next time the collective fires. This is the exact shape of the classic
+multi-host deadlock (a "quick metrics allreduce" tucked into a
+`process_index == 0` logging branch).
+"""
+
+import jax
+
+from tpu_dp.parallel import collectives
+
+
+def broken_epoch_summary(metrics):
+    if jax.process_index() == 0:
+        total = collectives.psum(metrics["loss"])  # EXPECT: DP101
+        print("epoch loss:", total)

@@ -1,0 +1,23 @@
+"""Adversarial dplint fixture — DP102: host nondeterminism in device code.
+
+`time.time()` evaluates once per process at trace time, so each replica
+compiles a different constant into what must be one identical SPMD
+program; the nondeterministically-seeded PRNGKey gives every process its
+own "replicated" init.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def noisy_step(x):
+    jitter = time.time()  # EXPECT: DP102
+    return x * jitter
+
+
+def divergent_init():
+    key = jax.random.PRNGKey(int(time.time()))  # EXPECT: DP102
+    return jax.random.normal(key, (4,)) + jnp.zeros((4,))

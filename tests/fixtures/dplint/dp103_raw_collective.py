@@ -1,0 +1,18 @@
+"""Adversarial dplint fixture — DP103: raw collective / wrong axis literal.
+
+The raw `jax.lax.psum` dodges the audited wrappers in
+`tpu_dp.parallel.collectives`; the wrapper call over a literal `"model"`
+axis names an axis the one-axis data-parallel mesh does not define.
+"""
+
+import jax
+
+from tpu_dp.parallel import collectives
+
+
+def sneaky_allreduce(grads):
+    return jax.lax.psum(grads, "data")  # EXPECT: DP103
+
+
+def wrong_axis(grads):
+    return collectives.pmean(grads, "model")  # EXPECT: DP103

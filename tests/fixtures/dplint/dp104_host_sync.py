@@ -1,0 +1,17 @@
+"""Adversarial dplint fixture — DP104: host sync inside the hot step.
+
+`jax.device_get` / `.block_until_ready()` inside a jitted step serialize
+dispatch against execution on every iteration — the async-dispatch
+pipeline the whole TPU step-time story rests on collapses.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def chatty_step(state, batch):
+    loss = jnp.mean((batch - state) ** 2)
+    host_loss = jax.device_get(loss)  # EXPECT: DP104
+    loss.block_until_ready()  # EXPECT: DP104
+    return state - 0.1 * host_loss

@@ -1,0 +1,30 @@
+"""Adversarial dplint fixture — DP201: gradient never reduced.
+
+A per-shard step that applies raw local gradients straight to the
+(replicated) params: no data-axis collective anywhere, so each replica
+trains on its own shard and the "replicated" params silently diverge.
+
+`DPLINT_LOCAL_STEP` is the dplint jaxpr-pass hook: a zero-arg factory
+returning ``(step_fn, example_args)`` that the CLI traces with the
+``data`` axis bound.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def DPLINT_LOCAL_STEP():
+    def loss_fn(params, x):
+        return jnp.sum((x @ params) ** 2)
+
+    def step(state, batch):  # EXPECT: DP201
+        grads = jax.grad(loss_fn)(state["params"], batch["x"])
+        # BUG: no collectives.pmean(grads) before the update.
+        new_params = state["params"] - 0.1 * grads
+        return {"params": new_params}, {"grad_norm": jnp.sum(grads**2)}
+
+    example = (
+        {"params": jnp.ones((4, 2), jnp.float32)},
+        {"x": jnp.ones((8, 4), jnp.float32)},
+    )
+    return step, example

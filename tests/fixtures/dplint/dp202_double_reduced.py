@@ -1,0 +1,37 @@
+"""Adversarial dplint fixture — DP202: gradient reduced more than once.
+
+The classic gradient-accumulation bug: one pmean per microbatch *inside*
+the scan, plus the "standard" pmean after it. The update is silently
+rescaled — no crash, no hang, just a wrong effective learning rate.
+"""
+
+import jax
+import jax.numpy as jnp
+
+ACCUM_STEPS = 2
+
+
+def DPLINT_LOCAL_STEP():
+    def loss_fn(params, x):
+        return jnp.sum((x @ params) ** 2)
+
+    def step(state, batch):  # EXPECT: DP202
+        def micro(grads_acc, x_mb):
+            g = jax.grad(loss_fn)(state["params"], x_mb)
+            # BUG: reduced once per microbatch...
+            g = jax.lax.pmean(g, "data")  # dplint: allow(DP103)
+            return grads_acc + g, None
+
+        zeros = jnp.zeros_like(state["params"])
+        grads, _ = jax.lax.scan(micro, zeros, batch["x"])
+        grads = grads / ACCUM_STEPS
+        # ...AND once per update.
+        grads = jax.lax.pmean(grads, "data")  # dplint: allow(DP103)
+        new_params = state["params"] - 0.1 * grads
+        return {"params": new_params}, {}
+
+    example = (
+        {"params": jnp.ones((4, 2), jnp.float32)},
+        {"x": jnp.ones((ACCUM_STEPS, 8, 4), jnp.float32)},
+    )
+    return step, example

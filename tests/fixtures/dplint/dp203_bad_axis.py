@@ -1,0 +1,27 @@
+"""Adversarial dplint fixture — DP203: collective over an unknown mesh axis.
+
+The reduction is spelled over ``"model"`` but the data-parallel mesh
+defines only the ``data`` axis; the program only fails when the full step
+finally traces — or deadlocks on a mesh where the name happens to exist
+with a different size.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def DPLINT_LOCAL_STEP():
+    def loss_fn(params, x):
+        return jnp.sum((x @ params) ** 2)
+
+    def step(state, batch):  # EXPECT: DP203
+        grads = jax.grad(loss_fn)(state["params"], batch["x"])
+        # BUG: the mesh has no "model" axis.
+        grads = jax.lax.pmean(grads, "model")  # dplint: allow(DP103)
+        return {"params": state["params"] - 0.1 * grads}, {}
+
+    example = (
+        {"params": jnp.ones((4, 2), jnp.float32)},
+        {"x": jnp.ones((8, 4), jnp.float32)},
+    )
+    return step, example

@@ -1,0 +1,20 @@
+"""Adversarial dplint fixture — DP204: donated buffer read after donation.
+
+`make_train_step` compiles with ``donate_argnums=(0,)``: the `state`
+passed in is handed to XLA for buffer reuse, and the Python object left
+behind is dead. Reading it after the call returns garbage (or raises a
+deleted-buffer error) on real backends.
+"""
+
+from tpu_dp.train.step import make_train_step
+
+
+def broken_loop(model, optimizer, mesh, schedule, state, batches):
+    train_step = make_train_step(model, optimizer, mesh, schedule)
+    losses = []
+    for batch in batches:
+        new_state, metrics = train_step(state, batch)
+        losses.append(metrics["loss"])
+        # BUG: `state` was donated above and never rebound.
+        print("step", state.step)  # EXPECT: DP204
+    return losses

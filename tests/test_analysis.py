@@ -1,0 +1,259 @@
+"""dplint (`tpu_dp.analysis`) — the static SPMD-correctness analyzer.
+
+Three layers of coverage:
+
+1. Adversarial fixtures (`tests/fixtures/dplint/`): one known-bad module
+   per rule, DP101–DP104 and DP201–DP204. Each fixture marks the line its
+   finding must be attributed to with an ``# EXPECT: <RULE>`` comment; the
+   test drives the real CLI (`tpu_dp.analysis.cli.main`) and asserts the
+   exit code, the rule id, the file, and the line.
+2. The shipped tree is clean: `python -m tpu_dp.analysis tpu_dp/` exits 0
+   (every legitimate gate carries an audited allow-pragma, every genuine
+   finding was fixed).
+3. The gradient-sync regression: the jaxpr pass proves the real
+   `make_local_step` program reduces every parameter leaf's gradient over
+   the data axis exactly once per optimizer update, for accum_steps 1 and
+   >1 (guards against silent double-averaging under gradient
+   accumulation).
+
+Fast lane: ``pytest -m analysis``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from tpu_dp.analysis import astlint, lint_source
+from tpu_dp.analysis.cli import main as dplint_main
+from tpu_dp.analysis.report import RULES
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "dplint")
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*(DP\d{3})")
+
+FIXTURE_FILES = sorted(
+    f for f in os.listdir(FIXTURES) if f.endswith(".py")
+)
+
+
+def _expected_findings(path: str) -> list[tuple[str, int]]:
+    """(rule, line) pairs a fixture's `# EXPECT: DPxxx` comments declare."""
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, text in enumerate(f, start=1):
+            for m in _EXPECT_RE.finditer(text):
+                out.append((m.group(1), lineno))
+    return out
+
+
+def _run_cli(capsys, argv: list[str]) -> tuple[int, dict]:
+    rc = dplint_main(argv + ["--json"])
+    payload = json.loads(capsys.readouterr().out)
+    return rc, payload
+
+
+# -- 1. every adversarial fixture fires its rule at its line -------------
+
+@pytest.mark.parametrize("fixture", FIXTURE_FILES)
+def test_fixture_fires_expected_rule(fixture, capsys):
+    path = os.path.join(FIXTURES, fixture)
+    expected = _expected_findings(path)
+    assert expected, f"{fixture} declares no # EXPECT: comments"
+
+    rc, payload = _run_cli(capsys, [path])
+    assert rc == 1, f"{fixture}: expected exit 1, got {rc}"
+    got = {(f["rule"], f["line"]) for f in payload["findings"]}
+    for rule, line in expected:
+        assert (rule, line) in got, (
+            f"{fixture}: expected {rule} at line {line}, findings: {got}"
+        )
+    for f in payload["findings"]:
+        assert f["path"] == path
+
+
+def test_all_rules_covered_by_fixtures():
+    """Every documented rule has at least one adversarial fixture."""
+    covered = set()
+    for fixture in FIXTURE_FILES:
+        for rule, _ in _expected_findings(os.path.join(FIXTURES, fixture)):
+            covered.add(rule)
+    assert covered == set(RULES), (
+        f"rules without a fixture: {set(RULES) - covered}"
+    )
+
+
+# -- 2. the shipped tree is clean ----------------------------------------
+
+def test_shipped_tree_is_clean_ast():
+    """AST rules + donation check: zero unsuppressed findings in tpu_dp/."""
+    rc = dplint_main([os.path.join(REPO, "tpu_dp"), "--no-jaxpr"])
+    assert rc == 0
+
+
+def test_shipped_tree_is_clean_full(capsys):
+    """The full two-level run (`python -m tpu_dp.analysis tpu_dp/`) exits 0:
+    AST rules, donation check, and the jaxpr gradient-sync pass over the
+    real step for accum_steps ∈ {1, 2}."""
+    rc, payload = _run_cli(capsys, [os.path.join(REPO, "tpu_dp")])
+    assert payload["findings"] == []
+    assert rc == 0
+
+
+def test_cli_launcher_runs_from_checkout():
+    """tools/dplint.py works without installing the package."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "dplint.py"),
+         "--list-rules"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    for rule in RULES:
+        assert rule in proc.stdout
+
+
+# -- 3. gradient-sync regression: exactly one reduction per leaf ---------
+
+@pytest.mark.parametrize("accum_steps", [1, 4])
+def test_exactly_one_reduction_per_param_leaf(accum_steps):
+    """The shipped per-shard step reduces every parameter gradient over the
+    data axis exactly once per optimizer update — including under gradient
+    accumulation, where the single reduction must sit after the microbatch
+    scan (one pmean per update, never one per microbatch)."""
+    from tpu_dp.analysis import gradsync
+
+    findings, report = gradsync.verify_repo_step(accum_steps=accum_steps)
+    assert findings == []
+    assert report, "no parameter leaves found in the step outputs"
+    bad = {ks: n for ks, n in report.items() if n != 1}
+    assert not bad, (
+        f"accum_steps={accum_steps}: leaves without exactly one data-axis "
+        f"reduction: {bad}"
+    )
+
+
+def test_sync_bn_model_verifies_without_false_double_reduction():
+    """Sync-BN models do in-forward data-axis collectives whose AD
+    transposes sit on every gradient's backward path — legitimately more
+    than one reduction per leaf. verify_repo_step must drop to the
+    at-least-once half of the contract (no DP202 noise) while still
+    catching DP201."""
+    from tpu_dp.analysis import gradsync
+    from tpu_dp.parallel.dist import DATA_AXIS
+
+    findings, report = gradsync.verify_repo_step(
+        model_name="resnet18", num_filters=8, axis_name=DATA_AXIS
+    )
+    assert findings == []
+    assert report and all(n >= 1 for n in report.values())
+
+
+def test_accum_report_has_same_leaves_as_plain():
+    """Accumulation changes the schedule, not the parameter tree: both
+    variants must verify the identical set of gradient leaves."""
+    from tpu_dp.analysis import gradsync
+
+    _, plain = gradsync.verify_repo_step(accum_steps=1)
+    _, accum = gradsync.verify_repo_step(accum_steps=3)
+    assert set(plain) == set(accum)
+
+
+# -- reviewer regressions -------------------------------------------------
+
+def test_nested_rank_gates_report_collective_once():
+    """A collective under two nested rank gates belongs to the innermost
+    gate: one finding, clearable by one pragma."""
+    src = (
+        "import jax\n"
+        "from tpu_dp.parallel import collectives\n"
+        "def f(rank, m):\n"
+        "    if jax.process_index() == 0:\n"
+        "        if rank == 0:\n"
+        "            collectives.psum(m)\n"
+    )
+    findings = lint_source("x.py", src)
+    assert [(f.rule, f.line) for f in findings] == [("DP101", 6)]
+    # The pragma on the inner gate line clears the file.
+    suppressed = src.replace(
+        "if rank == 0:", "if rank == 0:  # dplint: allow(DP101)"
+    )
+    assert lint_source("x.py", suppressed) == []
+
+
+def test_donation_multiline_call_argument_is_not_a_read():
+    """The donated argument's own Load inside a line-wrapped call is not a
+    read-after-donation; a genuine later read still is."""
+    from tpu_dp.analysis import donation
+
+    ok = (
+        "from tpu_dp.train.step import make_train_step\n"
+        "def loop(model, opt, mesh, sched, state, batch):\n"
+        "    train_step = make_train_step(model, opt, mesh, sched)\n"
+        "    state, metrics = train_step(\n"
+        "        state, batch)\n"
+        "    return state, metrics\n"
+    )
+    assert donation.check_source("x.py", ok) == []
+
+    bad = (
+        "from tpu_dp.train.step import make_train_step\n"
+        "def loop(model, opt, mesh, sched, state, batch):\n"
+        "    train_step = make_train_step(model, opt, mesh, sched)\n"
+        "    new_state, metrics = train_step(\n"
+        "        state, batch)\n"
+        "    return state.params\n"
+    )
+    findings = donation.check_source("x.py", bad)
+    assert [(f.rule, f.line) for f in findings] == [("DP204", 6)]
+
+
+# -- pragma handling ------------------------------------------------------
+
+def test_pragma_suppresses_only_named_rule():
+    src = (
+        "import jax\n"
+        "def f(g):\n"
+        "    return jax.lax.psum(g, 'data')  # dplint: allow(DP103)\n"
+        "def g(g):\n"
+        "    return jax.lax.psum(g, 'data')\n"
+    )
+    findings = lint_source("x.py", src)
+    assert [(f.rule, f.line) for f in findings] == [("DP103", 5)]
+
+
+def test_pragma_on_gate_line_covers_block():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    if jax.process_index() == 0:  # dplint: allow(DP101)\n"
+        "        print('host-only IO', x)\n"
+    )
+    assert lint_source("x.py", src) == []
+
+
+def test_pragma_inside_string_does_not_suppress():
+    src = (
+        "import jax\n"
+        "MSG = '# dplint: allow(DP103)'\n"
+        "def f(g):\n"
+        "    return jax.lax.psum(g, 'data')\n"
+    )
+    findings = lint_source("x.py", src)
+    assert [f.rule for f in findings] == ["DP103"]
+
+
+def test_iter_py_files_skips_pycache(tmp_path):
+    (tmp_path / "a.py").write_text("x = 1\n")
+    cache = tmp_path / "__pycache__"
+    cache.mkdir()
+    (cache / "a.cpython-310.py").write_text("x = 1\n")
+    files = astlint.iter_py_files([str(tmp_path)])
+    assert files == [str(tmp_path / "a.py")]

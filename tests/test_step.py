@@ -132,6 +132,40 @@ def test_shard_map_matches_gspmd(setup, mesh8):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+def test_shard_map_accum_matches_gspmd(setup, mesh8):
+    """Explicit-collectives path under gradient accumulation ≡ GSPMD path.
+
+    The one reduction must sit after the microbatch scan (the invariant
+    `tpu_dp.analysis` DP202 verifies statically); numerically that means
+    the accum shard_map step tracks the accum GSPMD step exactly.
+    """
+    from tpu_dp.train import make_train_step_shard_map
+
+    model, opt, state = setup
+    step_g = make_train_step(model, opt, mesh8, constant_lr(0.05),
+                             accum_steps=2)
+    step_s = make_train_step_shard_map(model, opt, mesh8, constant_lr(0.05),
+                                       accum_steps=2)
+    sg, ss = _copy(state), _copy(state)
+    for i in range(2):
+        flat = _make_batch(i, 32)
+        batch = {
+            "image": flat["image"].reshape(2, 16, 32, 32, 3),
+            "label": flat["label"].reshape(2, 16),
+        }
+        sg, mg = step_g(sg, batch)
+        ss, ms = step_s(ss, batch)
+        np.testing.assert_allclose(
+            float(mg["loss"]), float(ms["loss"]), rtol=1e-5
+        )
+        assert int(mg["correct"]) == int(ms["correct"])
+        assert int(mg["count"]) == int(ms["count"])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(sg.params), jax.tree_util.tree_leaves(ss.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
 def test_shard_map_sync_bn_resnet(mesh8):
     """shard_map path with a BatchNorm model (axis_name-synced stats)."""
     from tpu_dp.models import ResNet18

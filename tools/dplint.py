@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""dplint — static SPMD-correctness analyzer for tpu_dp.
+
+Thin launcher around `tpu_dp.analysis` so the tool runs from a checkout
+without installing the package:
+
+    tools/dplint.py                  # analyze the tpu_dp package (both levels)
+    tools/dplint.py --no-jaxpr path  # AST rules only
+    tools/dplint.py --list-rules
+
+Equivalent to `python -m tpu_dp.analysis`. Exit 0 clean / 1 findings.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_dp.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,6 +4,9 @@
 #
 #   tools/run_tier1.sh               # full tier-1 suite (CPU backend)
 #   tools/run_tier1.sh --resilience  # fast lane: only -m resilience tests
+#   tools/run_tier1.sh --dplint      # static-analysis lane: dplint over
+#                                    # tpu_dp/ + the -m analysis tests;
+#                                    # fails on any unsuppressed finding
 #
 # Exit code is pytest's; the DOTS_PASSED line echoes the pass count the
 # roadmap tracks across PRs.
@@ -14,6 +17,12 @@ LOG=${TIER1_LOG:-/tmp/_t1.log}
 
 if [ "${1:-}" = "--resilience" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m resilience \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--dplint" ]; then
+    env JAX_PLATFORMS=cpu python -m tpu_dp.analysis tpu_dp/ || exit 1
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m analysis \
         -p no:cacheprovider
 fi
 

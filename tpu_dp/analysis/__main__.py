@@ -1,0 +1,8 @@
+"""`python -m tpu_dp.analysis` — the dplint CLI."""
+
+import sys
+
+from tpu_dp.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

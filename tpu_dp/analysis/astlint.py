@@ -1,0 +1,387 @@
+"""Level-1 dplint: AST rules DP101–DP104 over the `tpu_dp` package.
+
+The implicit DDP contract this package relies on — every rank executes the
+same collectives in the same order — is invisible to Python: a collective
+inside a ``process_index == 0`` branch parses, traces, and then hangs the
+whole slice at run time. These rules are the lexical half of the contract
+checker (the jaxpr half is `tpu_dp.analysis.gradsync`):
+
+- DP101: collectives/barriers — or any call at all — lexically inside a
+  rank-gated branch. Collectives under a gate are the classic cross-rank
+  deadlock; other calls are flagged conservatively because a rank-divergent
+  side effect near collectives is how deadlocks incubate. Legitimate
+  host-only gates (logging, checkpoint IO) carry `# dplint: allow(DP101)`
+  on the `if` line.
+- DP102: host nondeterminism (time.*, np.random.*, random.*, os.urandom,
+  nondeterministically-seeded `jax.random.PRNGKey`) inside device code —
+  one host's entropy baked into a program all replicas must agree on.
+- DP103: raw `lax.psum`/`pmean`/... bypassing the typed wrappers in
+  `tpu_dp.parallel.collectives`, or a collective called with a literal axis
+  name other than `DATA_AXIS` — every collective goes through one audited
+  choke point on one axis.
+- DP104: `jax.device_get` / `.block_until_ready` inside device code — a
+  host sync compiled into the hot step.
+
+"Device code" is detected lexically: functions decorated with
+jit/shard_map, functions passed by name to jit/shard_map/pmap/lax.scan/
+while_loop/cond/fori_loop, anything lexically nested inside those, and —
+for `step.py`, whose step bodies are closures returned by factories —
+every nested function in the file.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+from tpu_dp.analysis import pragmas
+from tpu_dp.analysis.report import Finding
+
+# The one blessed mesh axis (kept in sync with tpu_dp.parallel.dist without
+# importing jax at lint time).
+DATA_AXIS_NAME = "data"
+
+_COLLECTIVE_NAMES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "ppermute", "all_to_all",
+    "psum_scatter", "axis_index",
+}
+_BARRIER_NAMES = {
+    "barrier", "fault_tolerant_barrier", "sync_global_devices",
+    "broadcast_one_to_all", "process_allgather",
+}
+_RANK_ATTRS = {"process_index", "is_main_process", "is_main"}
+_RANK_NAMES = {"rank", "local_rank", "process_index"}
+_NONDET_EXACT = {
+    "time.time", "time.time_ns", "time.perf_counter", "time.monotonic",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "os.urandom", "uuid.uuid4", "secrets.token_bytes",
+}
+_NONDET_PREFIXES = ("np.random.", "numpy.random.", "random.")
+_JIT_WRAPPERS = {
+    "jit", "jax.jit", "shard_map", "jax.shard_map", "_shard_map",
+    "jax.experimental.shard_map.shard_map", "pmap", "jax.pmap",
+}
+_FN_CONSUMERS = _JIT_WRAPPERS | {
+    "lax.scan", "jax.lax.scan", "lax.while_loop", "jax.lax.while_loop",
+    "lax.cond", "jax.lax.cond", "lax.fori_loop", "jax.lax.fori_loop",
+    "lax.switch", "jax.lax.switch",
+}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'jax.lax.psum' for Name/Attribute chains; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_collective_call(call: ast.Call) -> str | None:
+    """The collective's name if this call is a collective/barrier."""
+    dotted = _dotted(call.func)
+    if dotted is None:
+        return None
+    last = dotted.rsplit(".", 1)[-1]
+    if last in _COLLECTIVE_NAMES or last in _BARRIER_NAMES:
+        return dotted
+    return None
+
+
+def _is_rank_divergent_test(test: ast.AST) -> bool:
+    """True if the branch condition can differ across ranks."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) and node.attr in _RANK_ATTRS:
+            return True
+        if isinstance(node, ast.Name) and node.id in _RANK_NAMES:
+            return True
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            if dotted and dotted.rsplit(".", 1)[-1] in _RANK_ATTRS:
+                return True
+    return False
+
+
+def _nondet_call(call: ast.Call) -> str | None:
+    dotted = _dotted(call.func)
+    if dotted is None:
+        return None
+    if dotted in _NONDET_EXACT:
+        return dotted
+    for prefix in _NONDET_PREFIXES:
+        if dotted.startswith(prefix):
+            return dotted
+    return None
+
+
+def _collect_device_functions(tree: ast.Module, path: str) -> set[ast.AST]:
+    """FunctionDefs whose bodies run inside a compiled program."""
+    is_step_file = os.path.basename(path) == "step.py"
+    by_name: dict[str, list[ast.AST]] = {}
+    fndefs: list[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fndefs.append(node)
+            by_name.setdefault(node.name, []).append(node)
+
+    roots: set[ast.AST] = set()
+    # (a) decorated with a jit/shard_map wrapper (possibly via partial(...)).
+    for fn in fndefs:
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            dotted = _dotted(target)
+            if dotted in _JIT_WRAPPERS:
+                roots.add(fn)
+            elif isinstance(dec, ast.Call) and dotted and (
+                dotted.rsplit(".", 1)[-1] == "partial"
+            ):
+                for arg in dec.args:
+                    if _dotted(arg) in _JIT_WRAPPERS:
+                        roots.add(fn)
+    # (b) passed by name to jit/shard_map/scan/while/cond/...
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted not in _FN_CONSUMERS:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Name) and arg.id in by_name:
+                roots.update(by_name[arg.id])
+
+    # (c) lexical descendants of a root; for step.py (factory pattern: the
+    # step program is a closure returned by make_*), every nested function.
+    device: set[ast.AST] = set(roots)
+    for fn in fndefs:
+        for inner in ast.walk(fn):
+            if inner is fn:
+                continue
+            if isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if fn in device or is_step_file:
+                    device.add(inner)
+    return device
+
+
+class _Linter:
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.allowed = pragmas.collect(source)
+        self.findings: list[Finding] = []
+
+    def _emit(self, rule: str, line: int, message: str,
+              extra_lines: tuple[int, ...] = ()) -> None:
+        if pragmas.is_allowed(self.allowed, rule, (line,) + extra_lines):
+            return
+        self.findings.append(Finding(rule, self.path, line, message))
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.source, filename=self.path)
+        except SyntaxError as e:
+            self.findings.append(Finding(
+                "DP100", self.path, e.lineno or 1,
+                f"file does not parse: {e.msg}",
+            ))
+            return self.findings
+        in_collectives_module = self.path.replace(os.sep, "/").endswith(
+            "parallel/collectives.py"
+        )
+        device_fns = _collect_device_functions(tree, self.path)
+        device_nodes: set[int] = set()
+        for fn in device_fns:
+            for node in ast.walk(fn):
+                device_nodes.add(id(node))
+
+        self._check_rank_gates(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            in_device = id(node) in device_nodes
+            if not in_collectives_module:
+                self._check_raw_collective(node)
+            self._check_axis_literal(node)
+            self._check_prngkey_seed(node)
+            if in_device:
+                self._check_nondeterminism(node)
+                self._check_host_sync(node)
+        return self.findings
+
+    # -- DP101 ---------------------------------------------------------
+    @staticmethod
+    def _walk_gate(stmts: list[ast.stmt]):
+        """Walk a gated block, NOT descending into nested rank-divergent
+        `if`s — those are gates of their own and report their own
+        contents (one finding and one pragma per gate, never two)."""
+        stack = list(stmts)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.If) and _is_rank_divergent_test(
+                node.test
+            ):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_rank_gates(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.If):
+                continue
+            if not _is_rank_divergent_test(node.test):
+                continue
+            collective = None
+            has_work = False
+            for inner in self._walk_gate(node.body + node.orelse):
+                if isinstance(inner, ast.Call):
+                    name = _is_collective_call(inner)
+                    if name and collective is None:
+                        collective = (inner.lineno, name)
+                    has_work = True
+                elif isinstance(inner, (ast.Return, ast.Raise,
+                                        ast.Break, ast.Continue)):
+                    has_work = True
+            if collective is not None:
+                line, name = collective
+                self._emit(
+                    "DP101", line,
+                    f"collective `{name}` inside a rank-gated branch — only "
+                    f"some ranks reach it, the others wait forever "
+                    f"(gate at line {node.lineno})",
+                    extra_lines=(node.lineno,),
+                )
+            elif has_work:
+                self._emit(
+                    "DP101", node.lineno,
+                    "rank-divergent branch performs calls or alters control "
+                    "flow; if this gate is host-only IO (logging, "
+                    "checkpoint), annotate it with `# dplint: allow(DP101)`",
+                )
+
+    # -- DP102 ---------------------------------------------------------
+    def _check_nondeterminism(self, call: ast.Call) -> None:
+        name = _nondet_call(call)
+        if name:
+            self._emit(
+                "DP102", call.lineno,
+                f"host-nondeterministic `{name}` inside device code — the "
+                f"compiled step must be a pure function every replica "
+                f"agrees on; thread randomness through seeded jax.random "
+                f"keys instead",
+            )
+
+    def _check_prngkey_seed(self, call: ast.Call) -> None:
+        dotted = _dotted(call.func)
+        if dotted is None or dotted.rsplit(".", 1)[-1] != "PRNGKey":
+            return
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for inner in ast.walk(arg):
+                if isinstance(inner, ast.Call) and _nondet_call(inner):
+                    self._emit(
+                        "DP102", call.lineno,
+                        f"PRNGKey seeded from `{_nondet_call(inner)}` — "
+                        f"each process derives a different key, so "
+                        f"replicated params/augmentation silently diverge; "
+                        f"seed from config",
+                    )
+                    return
+
+    # -- DP103 ---------------------------------------------------------
+    def _check_raw_collective(self, call: ast.Call) -> None:
+        dotted = _dotted(call.func)
+        if dotted is None:
+            return
+        last = dotted.rsplit(".", 1)[-1]
+        if last not in _COLLECTIVE_NAMES:
+            return
+        if "collectives" in dotted.split("."):
+            return  # the typed wrappers themselves
+        self._emit(
+            "DP103", call.lineno,
+            f"raw `{dotted}` bypasses the typed wrappers in "
+            f"tpu_dp.parallel.collectives — route collectives through the "
+            f"audited choke point (or `# dplint: allow(DP103)` for "
+            f"low-level partitioning code)",
+        )
+
+    def _check_axis_literal(self, call: ast.Call) -> None:
+        dotted = _dotted(call.func)
+        if dotted is None:
+            return
+        last = dotted.rsplit(".", 1)[-1]
+        if last not in _COLLECTIVE_NAMES:
+            return
+        axis_args = [kw.value for kw in call.keywords
+                     if kw.arg in ("axis_name", "axis")]
+        if not axis_args and len(call.args) >= 2:
+            axis_args = [call.args[1]]
+        for arg in axis_args:
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if arg.value != DATA_AXIS_NAME:
+                    self._emit(
+                        "DP103", call.lineno,
+                        f"collective over literal axis {arg.value!r} — the "
+                        f"data-parallel mesh has one axis, "
+                        f"{DATA_AXIS_NAME!r} (use DATA_AXIS)",
+                    )
+
+    # -- DP104 ---------------------------------------------------------
+    def _check_host_sync(self, call: ast.Call) -> None:
+        dotted = _dotted(call.func)
+        if dotted is None:
+            if isinstance(call.func, ast.Attribute) and (
+                call.func.attr == "block_until_ready"
+            ):
+                self._emit(
+                    "DP104", call.lineno,
+                    ".block_until_ready() inside device code — a host sync "
+                    "compiled into the hot step",
+                )
+            return
+        last = dotted.rsplit(".", 1)[-1]
+        if last == "device_get":
+            self._emit(
+                "DP104", call.lineno,
+                f"`{dotted}` inside device code — device→host transfer in "
+                f"the hot step serializes dispatch against execution",
+            )
+        elif last == "block_until_ready":
+            self._emit(
+                "DP104", call.lineno,
+                f"`{dotted}` inside device code — a host sync compiled "
+                f"into the hot step",
+            )
+
+
+def lint_source(path: str, source: str) -> list[Finding]:
+    return _Linter(path, source).run()
+
+
+def lint_file(path: str) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(path, f.read())
+
+
+def iter_py_files(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(root, name))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_paths(paths: Iterable[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path))
+    return findings

@@ -1,0 +1,54 @@
+"""`# dplint: allow(RULE)` pragma parsing and suppression.
+
+A finding is suppressed when a pragma naming its rule (or `all`) sits on the
+finding's own line or on any of the extra lines the rule attributes to it
+(e.g. DP101 accepts the pragma on the `if` line of the rank gate, so one
+pragma covers the whole gated block). Pragmas are comments, collected with
+`tokenize` so strings that merely *contain* the pragma text don't suppress
+anything.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+
+_PRAGMA_RE = re.compile(
+    r"#\s*dplint:\s*allow\(\s*([A-Za-z0-9_,\s]+?)\s*\)", re.IGNORECASE
+)
+
+
+def collect(source: str) -> dict[int, set[str]]:
+    """Map line number -> set of allowed rule ids (upper-cased) for a file."""
+    allowed: dict[int, set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip().upper() for r in m.group(1).split(",")
+                     if r.strip()}
+            allowed.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        # A file that doesn't tokenize produces no pragmas; the AST parse
+        # will surface the real syntax error.
+        pass
+    return allowed
+
+
+def is_allowed(
+    allowed: dict[int, set[str]],
+    rule: str,
+    lines: tuple[int, ...],
+) -> bool:
+    """True if any of ``lines`` carries a pragma for ``rule`` (or 'ALL')."""
+    rule = rule.upper()
+    for line in lines:
+        rules = allowed.get(line)
+        if rules and (rule in rules or "ALL" in rules):
+            return True
+    return False

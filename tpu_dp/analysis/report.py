@@ -1,0 +1,103 @@
+"""dplint findings: the shared record every rule emits and the CLI prints.
+
+One `Finding` per violation, attributed to a file:line so editors and CI can
+jump to it. Rule metadata lives in `RULES` — `docs/ANALYSIS.md` is the prose
+version, this table is what `--list-rules` prints and what tests assert
+against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str  # "DP101" ... "DP204"
+    path: str  # file the finding is attributed to
+    line: int  # 1-based line number
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# rule id -> (title, one-line failure mode). Level 1 (DP1xx) is the AST
+# lint; level 2 (DP2xx) is the jaxpr/semantic pass.
+RULES: dict[str, tuple[str, str]] = {
+    "DP101": (
+        "collective or rank-divergent work under a rank gate",
+        "a collective reached by only some ranks deadlocks the slice; any "
+        "call under a process_index gate needs an allow-pragma audit",
+    ),
+    "DP102": (
+        "host nondeterminism in device code",
+        "time/np.random/unseeded PRNGKey inside jitted code bakes one "
+        "host's entropy into a program all replicas must agree on",
+    ),
+    "DP103": (
+        "raw collective bypassing the typed wrappers",
+        "lax.psum/pmean outside tpu_dp.parallel.collectives, or a literal "
+        "axis name other than DATA_AXIS, dodges the one audited choke point",
+    ),
+    "DP104": (
+        "host sync inside the hot step",
+        "jax.device_get / .block_until_ready in device code serializes "
+        "dispatch against execution every step",
+    ),
+    "DP201": (
+        "gradient never reduced over the data axis",
+        "a parameter whose gradient is not all-reduced trains on one "
+        "shard's data — replicas silently diverge",
+    ),
+    "DP202": (
+        "gradient reduced more than once",
+        "a double pmean (e.g. once per microbatch AND once per update) "
+        "silently rescales the effective learning rate",
+    ),
+    "DP203": (
+        "collective over an unknown mesh axis",
+        "an axis name not bound by the mesh fails at trace time on the "
+        "full program — or deadlocks where sizes disagree",
+    ),
+    "DP204": (
+        "donated buffer read after donation",
+        "an argument passed to a donate_argnums step is dead afterwards; "
+        "reading it returns garbage or raises on real backends",
+    ),
+}
+
+
+def sort_findings(findings: list[Finding]) -> list[Finding]:
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def render_text(findings: list[Finding]) -> str:
+    lines = [f.format() for f in sort_findings(findings)]
+    lines.append(
+        f"dplint: {len(findings)} finding(s)" if findings
+        else "dplint: clean"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding]) -> str:
+    return json.dumps(
+        {"findings": [f.to_dict() for f in sort_findings(findings)],
+         "count": len(findings)},
+        indent=2,
+    )
+
+
+def list_rules() -> str:
+    lines = []
+    for rule, (title, failure) in RULES.items():
+        lines.append(f"{rule}  {title}")
+        lines.append(f"       {failure}")
+    return "\n".join(lines)

@@ -59,7 +59,7 @@ def save_checkpoint(
     meta: dict[str, Any] | None = None,
 ) -> Path | None:
     """Write state + metadata; process 0 only. Returns the path (rank 0)."""
-    if jax.process_index() != 0:
+    if jax.process_index() != 0:  # dplint: allow(DP101) host-only IO
         return None
     return _atomic_write_state(Path(ckpt_dir), _to_host(state), meta)
 
@@ -146,7 +146,7 @@ class CheckpointManager:
         instead of paying a fresh device→host copy + allocation here; the
         buffer must stay untouched until the next ``save``/``wait``.
         """
-        if jax.process_index() != 0:
+        if jax.process_index() != 0:  # dplint: allow(DP101) host-only IO
             return None
         self.wait()
         n = int(state.step) if step is None else int(step)
@@ -219,7 +219,7 @@ class CheckpointManager:
 def save_params(path: str | os.PathLike, params) -> Path | None:
     """Final-weights export — `torch.save(state_dict)` analogue
     (`cifar_example.py:92-93`), written once by process 0, clean key names."""
-    if jax.process_index() != 0:
+    if jax.process_index() != 0:  # dplint: allow(DP101) host-only IO
         return None
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)

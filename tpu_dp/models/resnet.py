@@ -28,6 +28,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tpu_dp.parallel import collectives
 from tpu_dp.ops.conv_block import (
     fused_affine_relu_conv,
     fused_affine_relu_conv_emit,
@@ -84,8 +85,8 @@ class BatchNormCoeffs(nn.Module):
                 mean = jnp.mean(xf, axis=(0, 1, 2))
                 mean2 = jnp.mean(jnp.square(xf), axis=(0, 1, 2))
             if self.axis_name is not None:
-                mean = jax.lax.pmean(mean, self.axis_name)
-                mean2 = jax.lax.pmean(mean2, self.axis_name)
+                mean = collectives.pmean(mean, self.axis_name)
+                mean2 = collectives.pmean(mean2, self.axis_name)
             var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
             if not self.is_initializing():
                 ra_mean.value = (self.momentum * ra_mean.value

@@ -316,8 +316,10 @@ def _make_cp(with_res, emit_z=False, emit_stats=False):
             out = _run_local(x, w, scale, shift, residual, block_b, activate,
                              emit_z, emit_stats)
             if emit_stats and batch is not None:
-                # Per-shard partial sums -> global sums over the batch axis.
-                out = out[:-1] + (jax.lax.psum(out[-1], batch),)
+                # Per-shard partial sums -> global sums over whatever axis
+                # the partitioner sharded the batch on (not necessarily
+                # DATA_AXIS — this is mesh-generic lowering code).
+                out = out[:-1] + (jax.lax.psum(out[-1], batch),)  # dplint: allow(DP103)
             return out
 
         if with_res:

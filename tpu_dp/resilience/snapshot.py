@@ -100,7 +100,7 @@ class SnapshotManager:
         Process-0-only like the underlying manager.
         """
         self._last_step = int(global_step)
-        if jax.process_index() != 0:
+        if jax.process_index() != 0:  # dplint: allow(DP101) host-only IO
             return None
         host_state = self._host_copy(state)
         meta = dict(meta or {})

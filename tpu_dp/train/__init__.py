@@ -13,6 +13,7 @@ from tpu_dp.train.state import TrainState, create_train_state
 from tpu_dp.train.step import (
     cross_entropy_loss,
     make_eval_step,
+    make_local_step,
     make_multi_step,
     make_train_step,
     make_train_step_shard_map,
@@ -29,6 +30,7 @@ __all__ = [
     "create_train_state",
     "cross_entropy_loss",
     "make_eval_step",
+    "make_local_step",
     "make_multi_step",
     "make_schedule",
     "make_train_step",

@@ -119,13 +119,24 @@ def _apply_model(model, state: TrainState, images, train: bool):
     return model.apply({"params": state.params}, images, train=train), {}
 
 
-def _forward_backward(model, loss_impl, state: TrainState, images, labels):
+def _forward_backward(model, loss_impl, state: TrainState, images, labels,
+                      cast_params=None):
     """Shared fwd+bwd block: loss, grads, updated BN stats, correct count.
 
     Train batches are always full (drop_remainder enforced), so no weight
     mask on the training loss. Used by both step factories so the GSPMD and
     explicit-`shard_map` paths cannot drift apart.
+
+    ``cast_params`` (per-leaf, applied *before* differentiation) is the
+    explicit-collectives path's varying-cast hook: under shard_map's
+    replication typing, differentiating a *varying* loss wrt *invariant*
+    params would insert an implicit cross-shard psum (the cotangent of the
+    invariant→varying broadcast) before the explicit collective — casting
+    outside the diff'd function keeps AD local, per-shard grads out.
     """
+    params0 = state.params
+    if cast_params is not None:
+        params0 = jax.tree_util.tree_map(cast_params, params0)
 
     def loss_fn(params):
         logits, new_batch_stats = _apply_model(
@@ -135,7 +146,7 @@ def _forward_backward(model, loss_impl, state: TrainState, images, labels):
 
     (loss, (logits, new_batch_stats)), grads = jax.value_and_grad(
         loss_fn, has_aux=True
-    )(state.params)
+    )(params0)
     correct = jnp.sum(jnp.argmax(logits, axis=-1) == labels)
     return loss, grads, new_batch_stats, correct
 
@@ -167,11 +178,19 @@ def _select_loss_impl(use_pallas_xent: bool):
     return cross_entropy_loss
 
 
-def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn):
+def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn,
+                    reduce_fn=None, cast_params=None):
     """The single-microbatch step body shared by `make_train_step`
     (accum_steps=1) and `make_multi_step`'s scan — one source of truth for
-    normalize → augment → fwd/bwd → update → metrics, so the host-loop and
-    device-loop paths cannot drift apart."""
+    normalize → augment → fwd/bwd → [cross-replica reduce] → update →
+    metrics, so the host-loop and device-loop paths cannot drift apart.
+
+    ``reduce_fn(grads, loss, correct, count, batch_stats)`` is the
+    explicit-collectives hook: the GSPMD path passes None (the partitioner
+    infers the gradient all-reduce from shardings), the `shard_map` path
+    injects the typed collective wrappers between the per-shard grads and
+    the optimizer update — the one placement `tpu_dp.analysis` verifies.
+    """
 
     def body(state: TrainState, batch):
         images, labels = _maybe_normalize(batch["image"]), batch["label"]
@@ -180,15 +199,20 @@ def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn):
             # deterministic, identical on every replica.
             images = augment_fn(state.step, images)
         loss, grads, new_batch_stats, correct = _forward_backward(
-            model, loss_impl, state, images, labels
+            model, loss_impl, state, images, labels, cast_params=cast_params
         )
+        count = jnp.asarray(labels.shape[0], jnp.int32)
+        if reduce_fn is not None:
+            grads, loss, correct, count, new_batch_stats = reduce_fn(
+                grads, loss, correct, count, new_batch_stats
+            )
         new_state, lr = _apply_update(
             optimizer, schedule, state, grads, new_batch_stats
         )
         metrics = {
             "loss": loss,
             "correct": correct,
-            "count": jnp.asarray(labels.shape[0], jnp.int32),
+            "count": count,
             "lr": lr,
         }
         return new_state, metrics
@@ -197,7 +221,8 @@ def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn):
 
 
 def _make_accum_body(
-    model, optimizer, schedule, loss_impl, augment_fn, accum_steps
+    model, optimizer, schedule, loss_impl, augment_fn, accum_steps,
+    reduce_fn=None, cast_params=None,
 ):
     """The gradient-accumulation step body: one optimizer update from
     ``accum_steps`` sequential microbatches.
@@ -225,7 +250,8 @@ def _make_accum_body(
             grads_acc, batch_stats, loss_acc, correct_acc = carry
             mstate = state.replace(batch_stats=batch_stats)
             loss, grads, new_bs, correct = _forward_backward(
-                model, loss_impl, mstate, mb["image"], mb["label"]
+                model, loss_impl, mstate, mb["image"], mb["label"],
+                cast_params=cast_params,
             )
             grads_acc = jax.tree_util.tree_map(
                 jnp.add, grads_acc, grads
@@ -246,7 +272,15 @@ def _make_accum_body(
             lambda g: g / accum_steps, grads
         )
         loss = loss_sum / accum_steps
-        count = labels.shape[0] * labels.shape[1]
+        count = jnp.asarray(labels.shape[0] * labels.shape[1], jnp.int32)
+
+        # The reduce hook sits AFTER the microbatch scan and the 1/accum
+        # rescale: exactly one cross-replica reduction per optimizer update,
+        # never one per microbatch (`tpu_dp.analysis` DP202 verifies this).
+        if reduce_fn is not None:
+            grads, loss, correct, count, new_batch_stats = reduce_fn(
+                grads, loss, correct, count, new_batch_stats
+            )
 
         new_state, lr = _apply_update(
             optimizer, schedule, state, grads, new_batch_stats
@@ -254,7 +288,7 @@ def _make_accum_body(
         metrics = {
             "loss": loss,
             "correct": correct,
-            "count": jnp.asarray(count, jnp.int32),
+            "count": count,
             "lr": lr,
         }
         return new_state, metrics
@@ -263,16 +297,19 @@ def _make_accum_body(
 
 
 def _select_body(model, optimizer, schedule, loss_impl, augment_fn,
-                 accum_steps):
+                 accum_steps, reduce_fn=None, cast_params=None):
     """One source of truth for the per-update body: plain step at
-    accum_steps == 1, gradient-accumulation body otherwise. Used by both
-    `make_train_step` and `make_multi_step` so the host-loop and
-    device-loop paths share the exact same program."""
+    accum_steps == 1, gradient-accumulation body otherwise. Used by
+    `make_train_step`, `make_multi_step`, and (via `make_local_step`) the
+    explicit-collectives `shard_map` path, so all step programs share the
+    exact same body."""
     if accum_steps == 1:
         return _make_step_body(model, optimizer, schedule, loss_impl,
-                               augment_fn)
+                               augment_fn, reduce_fn=reduce_fn,
+                               cast_params=cast_params)
     return _make_accum_body(model, optimizer, schedule, loss_impl,
-                            augment_fn, accum_steps)
+                            augment_fn, accum_steps, reduce_fn=reduce_fn,
+                            cast_params=cast_params)
 
 
 def make_train_step(
@@ -437,24 +474,97 @@ def make_multi_step_resident(
     )
 
 
+def make_local_step(
+    model,
+    optimizer: Optimizer,
+    schedule: Schedule,
+    use_pallas_xent: bool = False,
+    accum_steps: int = 1,
+    augment_fn: Callable | None = None,
+    world: int = 1,
+    axis_name: str | None = None,
+    cast_params: bool = True,
+) -> Callable:
+    """The per-shard step program with *explicit* collectives, unjitted.
+
+    This is the SPMD program each device runs under
+    `make_train_step_shard_map`: the shared step body (`_select_body` — the
+    same normalize → augment → fwd/bwd → update the GSPMD path compiles)
+    with the cross-replica reduction written out between the per-shard
+    grads and the optimizer update — pmean(grads) / pmean(loss) /
+    psum(correct) over the ``data`` axis via the typed wrappers in
+    `tpu_dp.parallel.collectives`, a line-for-line statement of what DDP's
+    C++ reducer fires from backward hooks.
+
+    Exposed as a factory (rather than a closure inside the shard_map
+    wrapper) so `tpu_dp.analysis` can trace the *real shipped program* on
+    abstract values and verify the reduction invariant — every gradient
+    leaf reduced over the data axis exactly once per optimizer update,
+    including under gradient accumulation (`accum_steps > 1`, where the
+    reduction must sit after the microbatch scan, not inside it).
+
+    ``cast_params=False`` skips the varying-cast of the params (a no-op on
+    pre-vma JAX anyway); the analyzer uses it to trace outside a real
+    `shard_map` scope.
+    """
+    from tpu_dp.parallel import collectives
+    from tpu_dp.parallel.dist import DATA_AXIS
+
+    if axis_name is None:
+        axis_name = DATA_AXIS
+    loss_impl = _select_loss_impl(use_pallas_xent)
+
+    def reduce_fn(grads, loss, correct, count, batch_stats):
+        # The explicit DDP all-reduce: grad mean over the data axis,
+        # exactly once, after any gradient-accumulation scan.
+        grads = collectives.pmean(grads, axis_name)
+        loss = collectives.pmean(loss, axis_name)
+        correct = collectives.psum(correct, axis_name)
+        count = count * world
+        if getattr(model, "axis_name", None) is None and batch_stats:
+            # Unsynced BN model: average per-shard running stats so state
+            # leaves shard_map replicated. Models built with
+            # axis_name=DATA_AXIS already synced in-forward — skip the
+            # redundant per-step all-reduce over the stats tree.
+            batch_stats = collectives.pmean(batch_stats, axis_name)
+        return grads, loss, correct, count, batch_stats
+
+    # Mark the replicated params as device-varying before differentiating.
+    # Under shard_map's replication typing, grads of a *varying* loss wrt
+    # *invariant* params would get an implicit cross-shard psum inserted
+    # by AD (the cotangent of the invariant→varying broadcast) — i.e.
+    # globally-summed grads before our explicit collective, which would
+    # overscale the update by the world size. Casting params to
+    # *varying* keeps AD local: per-shard grads out, exactly what DDP's
+    # reducer sees pre-allreduce.
+    cast = (lambda p: _to_varying(p, axis_name)) if cast_params else None
+    return _select_body(model, optimizer, schedule, loss_impl, augment_fn,
+                        accum_steps, reduce_fn=reduce_fn, cast_params=cast)
+
+
 def make_train_step_shard_map(
     model,
     optimizer: Optimizer,
     mesh: Mesh,
     schedule: Schedule,
     use_pallas_xent: bool = False,
+    accum_steps: int = 1,
+    augment_fn: Callable | None = None,
 ) -> Callable:
     """Explicit-collectives variant of the DP train step (`shard_map`).
 
     Where `make_train_step` lets GSPMD *infer* the gradient all-reduce from
     sharding annotations, this path writes the distributed program per-shard,
-    with the collectives explicit: each device computes loss/grads over its
-    local shard of the global batch, then `lax.pmean`s the gradients over the
-    ``data`` mesh axis (ICI) — a line-for-line statement of what DDP's C++
-    reducer does from backward hooks (`/root/reference/cifar_example_ddp.py:83`),
-    but inside one compiled program. Both paths are equivalence-tested against
-    each other; this one is also the extension point for hand-scheduled
-    comms (e.g. overlapping grad reduction with remaining backward compute).
+    with the collectives explicit (`make_local_step`): each device computes
+    loss/grads over its local shard of the global batch, then pmeans the
+    gradients over the ``data`` mesh axis (ICI) — a line-for-line statement
+    of what DDP's C++ reducer does from backward hooks
+    (`/root/reference/cifar_example_ddp.py:83`), but inside one compiled
+    program. Both paths are equivalence-tested against each other; this one
+    is also the extension point for hand-scheduled comms (e.g. overlapping
+    grad reduction with remaining backward compute). Composes with gradient
+    accumulation: batch leaves gain a leading replicated (accum_steps,)
+    axis, the microbatch dim is the sharded one.
 
     BatchNorm models must be constructed with ``axis_name=DATA_AXIS`` so
     batch statistics sync across shards (the `shard_map` analogue of the
@@ -462,55 +572,22 @@ def make_train_step_shard_map(
     """
     from jax.sharding import PartitionSpec as P
 
-    from tpu_dp.parallel import collectives
     from tpu_dp.parallel.dist import DATA_AXIS
 
     repl = replicated_sharding(mesh)
-    batch_sh = batch_sharding(mesh)
     repl_spec = P()
-    batch_spec = P(DATA_AXIS)
-    world = int(mesh.devices.size)
-    loss_impl = _select_loss_impl(use_pallas_xent)
+    if accum_steps == 1:
+        batch_sh = batch_sharding(mesh)
+        batch_spec = P(DATA_AXIS)
+    else:
+        batch_sh = scan_batch_sharding(mesh)
+        batch_spec = P(None, DATA_AXIS)
 
-    def local_step(state: TrainState, batch):
-        images, labels = _maybe_normalize(batch["image"]), batch["label"]
-        # Mark the replicated params as device-varying before differentiating.
-        # Under shard_map's replication typing, grads of a *varying* loss wrt
-        # *invariant* params would get an implicit cross-shard psum inserted
-        # by AD (the cotangent of the invariant→varying broadcast) — i.e.
-        # globally-summed grads before our explicit collective, which would
-        # overscale the update by the world size. Casting params to
-        # *varying* keeps AD local: per-shard grads out, exactly what DDP's
-        # reducer sees pre-allreduce.
-        local_params = jax.tree_util.tree_map(
-            lambda p: _to_varying(p, DATA_AXIS), state.params
-        )
-        loss, grads, new_batch_stats, correct = _forward_backward(
-            model, loss_impl, state.replace(params=local_params),
-            images, labels
-        )
-
-        # The explicit DDP all-reduce: grad mean over the data axis.
-        grads = collectives.pmean(grads)
-        loss = jax.lax.pmean(loss, DATA_AXIS)
-        correct = jax.lax.psum(correct, DATA_AXIS)
-        if getattr(model, "axis_name", None) is None:
-            # Unsynced BN model: average per-shard running stats so state
-            # leaves shard_map replicated. Models built with
-            # axis_name=DATA_AXIS already synced in-forward — skip the
-            # redundant per-step all-reduce over the stats tree.
-            new_batch_stats = collectives.pmean(new_batch_stats)
-
-        new_state, lr = _apply_update(
-            optimizer, schedule, state, grads, new_batch_stats
-        )
-        metrics = {
-            "loss": loss,
-            "correct": correct,
-            "count": jnp.asarray(labels.shape[0] * world, jnp.int32),
-            "lr": lr,
-        }
-        return new_state, metrics
+    local_step = make_local_step(
+        model, optimizer, schedule, use_pallas_xent=use_pallas_xent,
+        accum_steps=accum_steps, augment_fn=augment_fn,
+        world=int(mesh.devices.size), axis_name=DATA_AXIS,
+    )
 
     # Replication checking stays ON: an output that is rank-varying (a
     # forgotten pmean/psum on a new metric) is a trace-time error instead of

@@ -255,10 +255,12 @@ class Trainer:
             return
         from jax.experimental import multihost_utils
 
-        if self.ctx.process_index == 0:
+        # Host-only IO stagger: rank 0 downloads, the barrier sits OUTSIDE
+        # both gates so every rank reaches it.
+        if self.ctx.process_index == 0:  # dplint: allow(DP101)
             self.train_ds, self.test_ds = _load()
         multihost_utils.sync_global_devices("tpu_dp_data_materialized")
-        if self.ctx.process_index != 0:
+        if self.ctx.process_index != 0:  # dplint: allow(DP101)
             self.train_ds, self.test_ds = _load()
 
     def _resume_position(self, meta: dict) -> tuple[int, int]:
@@ -310,7 +312,9 @@ class Trainer:
             )
             if not exists0:
                 return
-            if self.ctx.process_index == 0:
+            # Host-only checkpoint read; the broadcasts below are outside
+            # the gate, reached by every rank.
+            if self.ctx.process_index == 0:  # dplint: allow(DP101)
                 state, meta = ckpt_lib.load_checkpoint(resume_dir, self.state)
                 epoch, step = self._resume_position(meta)
                 pos = np.asarray([epoch, step], np.int32)
@@ -497,7 +501,7 @@ class Trainer:
         Structured observability the reference lacks (its only records are
         stdout prints, SURVEY.md §5 "Metrics / logging").
         """
-        if self.ctx.process_index != 0:
+        if self.ctx.process_index != 0:  # dplint: allow(DP101) host-only IO
             return
         path = Path(self.cfg.train.ckpt_dir) / "metrics.jsonl"
         path.parent.mkdir(parents=True, exist_ok=True)

@@ -35,11 +35,11 @@ def get_logger() -> logging.Logger:
 
 def log0(msg: str, *args, **kwargs) -> None:
     """Log from process 0 only (kwargs pass through, e.g. exc_info)."""
-    if jax.process_index() == 0:
+    if jax.process_index() == 0:  # dplint: allow(DP101) host-only logging
         get_logger().info(msg, *args, **kwargs)
 
 
 def print0(*args, **kwargs) -> None:
     """Print from process 0 only (reference-parity formatted prints)."""
-    if jax.process_index() == 0:
+    if jax.process_index() == 0:  # dplint: allow(DP101) host-only logging
         print(*args, **kwargs)
