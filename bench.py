@@ -236,6 +236,43 @@ def probe_device(attempts: int, timeout_s: float, retry_wait_s: float,
 # Child: one measurement point.
 # --------------------------------------------------------------------------
 
+def compile_with_flops(jitted, *eg_args):
+    """AOT-compile once; (executable, program FLOPs or None, compile stats).
+
+    The stats block is what lands in the BENCH json under "compile":
+    lowering/compile wall times plus the compiled module's collective-op
+    histogram (`tpu_dp.analysis.hlo.count_collectives` — the same Level-3
+    classifier dplint DP301 runs), so a PartitionSpec regression that
+    sneaks an all-gather into the hot loop shows up next to the throughput
+    number it explains.
+    """
+    t0 = time.perf_counter()
+    lowered = jitted.lower(*eg_args)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    stats = {
+        "lowering_ms": round((t1 - t0) * 1e3, 1),
+        "compile_ms": round((t2 - t1) * 1e3, 1),
+    }
+    try:
+        from tpu_dp.analysis.hlo import count_collectives
+
+        stats["hlo_collectives"] = count_collectives(compiled.as_text())
+    except Exception as e:  # never fail a measurement over a report stat
+        stats["hlo_collectives"] = None
+        print(f"bench: collective count failed ({e!r})", file=sys.stderr)
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        f = float(ca.get("flops", 0.0))
+        flops = f if f > 0 else None
+    except Exception:
+        flops = None
+    return compiled, flops, stats
+
+
 def measure_point(cfg: dict) -> dict:
     """Measure one (batch/chip, xent impl, window) point; return a record.
 
@@ -292,19 +329,6 @@ def measure_point(cfg: dict) -> dict:
     host_pool = [make_synthetic(global_batch, num_classes, seed=i, name="bench")
                  for i in range(4)]
 
-    def compile_with_flops(jitted, *eg_args):
-        """AOT-compile once; return (executable, program FLOPs or None)."""
-        compiled = jitted.lower(*eg_args).compile()
-        try:
-            ca = compiled.cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0]
-            f = float(ca.get("flops", 0.0))
-            flops = f if f > 0 else None
-        except Exception:
-            flops = None
-        return compiled, flops
-
     # Timing fence: fetch a scalar to host. On some PJRT transports (the
     # axon relay in this build env) `block_until_ready` returns before
     # device execution completes, overstating throughput ~60x; a
@@ -317,7 +341,8 @@ def measure_point(cfg: dict) -> dict:
             "label": np.stack([d.labels for d in host_pool]),
         }
         pool = shard_batch(stacked, mesh, spec=scan_batch_sharding(mesh))
-        loop_exe, program_flops = compile_with_flops(loop, state, pool)
+        loop_exe, program_flops, compile_stats = compile_with_flops(
+            loop, state, pool)
 
         state, metrics = loop_exe(state, pool)  # warmup window
         float(metrics["loss"][-1])
@@ -335,7 +360,8 @@ def measure_point(cfg: dict) -> dict:
                         spec=batch_sharding(mesh))
             for d in host_pool
         ]
-        step_exe, step_flops = compile_with_flops(step, state, batches[0])
+        step_exe, step_flops, compile_stats = compile_with_flops(
+            step, state, batches[0])
         program_flops = None  # no scan program on this path
 
         state, metrics = step_exe(state, batches[0])  # warmup
@@ -420,6 +446,9 @@ def measure_point(cfg: dict) -> dict:
             "flops_per_step_per_chip": flops_per_step,
             "flops_source": flops_source,
             "flops_check": flops_check,
+            # Lowering/compile wall times + the compiled module's
+            # collective histogram (dplint Level-3 classifier).
+            "compile": compile_stats,
             "backend": jax.default_backend(),
             "device_kind": device_kind,
             "n_chips": n_chips,
@@ -452,7 +481,7 @@ def measure_point(cfg: dict) -> dict:
             single = shard_batch(
                 {"image": host_pool[0].images, "label": host_pool[0].labels},
                 mesh, spec=batch_sharding(mesh))
-            _, step_flops = compile_with_flops(step, state, single)
+            _, step_flops, _ = compile_with_flops(step, state, single)
         except Exception as e:
             print(f"bench: w1 cost-analysis compile failed ({e!r}); "
                   f"keeping scan/analytic FLOPs reading", file=sys.stderr)

@@ -1,0 +1,35 @@
+"""Adversarial dplint fixture — DP301: extra all-gather in the compiled HLO.
+
+A "DP" program whose output sharding disagrees with what it computes: the
+input is sharded over ``data`` but the output is declared replicated, so the
+GSPMD partitioner silently materializes a cross-replica all-gather — per
+step, over the whole activation. Nothing at the source or jaxpr level is
+wrong; only the compiled artifact shows the collective. This is exactly what
+a bad `PartitionSpec` in `parallel/sharding.py` looks like after compilation.
+
+`DPLINT_HLO_PROGRAM` is the dplint Level-3 hook: a zero-arg factory
+returning the program (pre-jitted or a callable plus ``jit_kwargs``) and
+example args; the CLI lowers, compiles, and verifies the HLO text.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_dp.parallel import dist
+
+
+def DPLINT_HLO_PROGRAM():
+    mesh = dist.data_mesh()
+
+    def step(x):  # EXPECT: DP301
+        return x * 2.0
+
+    fn = jax.jit(
+        step,
+        in_shardings=(NamedSharding(mesh, P(dist.DATA_AXIS)),),
+        # BUG: replicating an un-reduced sharded tensor forces an
+        # all-gather of the whole activation every step.
+        out_shardings=NamedSharding(mesh, P()),
+    )
+    return {"fn": fn, "args": (jnp.zeros((16, 4), jnp.float32),)}

@@ -1,0 +1,22 @@
+"""Adversarial dplint fixture — DP302: host transfer in the compiled step.
+
+A leftover `jax.debug.print` inside the jitted step body compiles into a
+host-callback custom-call: every step round-trips to Python, serializing
+dispatch against execution — the async-dispatch pipeline the whole hot loop
+is built on collapses. The AST rules can't see it (debug.print is not a
+collective, not a sync primitive); the compiled module shows the
+custom-call.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def DPLINT_HLO_PROGRAM():
+    def step(x):  # EXPECT: DP302
+        # BUG: a debug print left in the hot step — compiles to a
+        # host-callback custom-call executed every single step.
+        jax.debug.print("loss={v}", v=x.sum())
+        return x + 1.0
+
+    return {"fn": step, "args": (jnp.zeros((8,), jnp.float32),)}

@@ -1,0 +1,32 @@
+"""Adversarial dplint fixture — DP303: buffer donation silently dropped.
+
+The caller donates its parameter buffers (``donate_argnums=(0,)``) expecting
+XLA to reuse them in place — but the output dtype differs from the input, so
+XLA cannot alias and *drops the donation with only a warning*: the program
+quietly double-allocates every "donated" buffer. At scale this is the
+difference between a model fitting in HBM and an OOM three hours in. The
+compiled module's missing ``input_output_alias`` entries are the only
+artifact of the drop.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def DPLINT_HLO_PROGRAM():
+    def step(params):  # EXPECT: DP303
+        # BUG: dtype changes f32 -> bf16, so the donated f32 buffers can
+        # never be reused for the bf16 outputs; XLA drops the aliasing.
+        return jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), params
+        )
+
+    params = {
+        "w": jnp.zeros((64, 64), jnp.float32),
+        "b": jnp.zeros((64,), jnp.float32),
+    }
+    return {
+        "fn": step,
+        "args": (params,),
+        "jit_kwargs": {"donate_argnums": (0,)},
+    }

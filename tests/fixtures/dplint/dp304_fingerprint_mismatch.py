@@ -1,0 +1,26 @@
+"""Adversarial dplint fixture — DP304: collective-schedule fingerprint drift.
+
+The program pins the collective-schedule fingerprint it was deployed with
+(``expect_fingerprint``) — the digest of the ordered collective sequence +
+replica groups `tpu_dp.analysis.hlo` computes and
+`artifacts/collective_fingerprint.json` records. The binary now compiles a
+*different* schedule than the pinned one: on a real pod, ranks running
+mismatched schedules deadlock mid-step with no error. The analyzer catches
+the drift at lint time; `tpu_dp.parallel.dist.verify_collective_fingerprint`
+is the runtime cross-rank half of the same contract.
+"""
+
+import jax.numpy as jnp
+
+
+def DPLINT_HLO_PROGRAM():
+    def step(x):  # EXPECT: DP304
+        return x * 2.0
+
+    return {
+        "fn": step,
+        "args": (jnp.zeros((8,), jnp.float32),),
+        # Pinned at deploy time; the schedule this binary compiles no
+        # longer digests to it.
+        "expect_fingerprint": "0" * 64,
+    }

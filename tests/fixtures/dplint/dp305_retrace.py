@@ -1,0 +1,19 @@
+"""Adversarial dplint fixture — DP305: retrace hazard at the jit boundary.
+
+`jax.jit` called inside the loop builds a *fresh wrapper object* — with a
+fresh, empty trace cache — on every iteration: every call retraces and
+recompiles the function, turning a microsecond dispatch into a multi-second
+compile, silently. (The runtime half of this rule is
+`tpu_dp.analysis.recompile.RecompileGuard`, which counts post-warmup
+trace-cache growth on the real step functions.)
+"""
+
+import jax
+
+
+def hot_loop(xs):
+    total = 0.0
+    for x in xs:
+        # BUG: a fresh jit wrapper (and empty compile cache) per iteration.
+        total = total + jax.jit(lambda v: v * v)(x)  # EXPECT: DP305
+    return total

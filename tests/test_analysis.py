@@ -257,3 +257,173 @@ def test_iter_py_files_skips_pycache(tmp_path):
     (cache / "a.cpython-310.py").write_text("x = 1\n")
     files = astlint.iter_py_files([str(tmp_path)])
     assert files == [str(tmp_path / "a.py")]
+
+
+# -- CLI exit codes + partial findings on internal error ------------------
+
+_RAW_PSUM = (
+    "import jax\n"
+    "def f(g):\n"
+    "    return jax.lax.psum(g, 'data')\n"
+)
+
+
+def test_cli_exit_0_on_clean_file(tmp_path, capsys):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    rc, payload = _run_cli(capsys, [str(tmp_path)])
+    assert rc == 0 and payload["findings"] == []
+
+
+def test_cli_exit_1_on_findings(tmp_path, capsys):
+    (tmp_path / "bad.py").write_text(_RAW_PSUM)
+    rc, payload = _run_cli(capsys, [str(tmp_path)])
+    assert rc == 1
+    assert [f["rule"] for f in payload["findings"]] == ["DP103"]
+
+
+def test_cli_exit_2_renders_partial_findings(tmp_path, capsys):
+    """An internal error (exit 2) must not discard the findings already
+    collected: they render to stdout (marked partial, still valid JSON)
+    while the traceback goes to stderr."""
+    (tmp_path / "bad.py").write_text(_RAW_PSUM)
+    # A Level-2 hook whose module import explodes: the AST findings above
+    # were already collected when the crash happens.
+    (tmp_path / "boom.py").write_text(
+        "raise RuntimeError('fixture import explodes')\n"
+        "def DPLINT_LOCAL_STEP():\n"
+        "    pass\n"
+    )
+    rc = dplint_main([str(tmp_path), "--json"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    payload = json.loads(captured.out)  # stdout stays machine-parseable
+    assert payload["partial"] is True
+    assert "RuntimeError" in payload["internal_error"]
+    assert [f["rule"] for f in payload["findings"]] == ["DP103"]
+    assert "Traceback" in captured.err  # the traceback went to stderr
+
+
+@pytest.mark.parametrize("spec", ["0", "abc", "-3"])
+def test_cli_bad_accum_steps_is_usage_error(spec, capsys):
+    """`--accum-steps` garbage is a clean exit-2 usage diagnostic on
+    stderr, not a traceback dressed as an internal error."""
+    rc = dplint_main(["--accum-steps", spec, os.path.join(FIXTURES,
+                                                          "__nope__")])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "bad --accum-steps" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_parse_accum_accepts_lists():
+    from tpu_dp.analysis.cli import _parse_accum
+
+    assert _parse_accum("1,2, 4") == [1, 2, 4]
+    assert _parse_accum("") == [1]
+    with pytest.raises(ValueError):
+        _parse_accum("0")
+
+
+# -- baseline suppression (stable fingerprints) ---------------------------
+
+def test_baseline_suppresses_preexisting_findings(tmp_path, capsys):
+    """CI adoption path: --write-baseline records today's findings by
+    rule+path+symbol fingerprint; --baseline then exits 0 on them — and
+    keeps exiting 0 after unrelated edits shift every line number."""
+    target = tmp_path / "legacy.py"
+    target.write_text(_RAW_PSUM)
+    rc, payload = _run_cli(capsys, [str(target)])
+    assert rc == 1
+    fp = payload["findings"][0]["fingerprint"]
+    assert fp.startswith("DP103:") and fp.endswith(":f")
+    assert not any(ch.isdigit() for ch in fp.rsplit(":", 1)[-1])
+
+    baseline = tmp_path / "baseline.json"
+    rc = dplint_main([str(target), "--write-baseline", str(baseline)])
+    capsys.readouterr()
+    assert rc == 0
+    assert json.loads(baseline.read_text())["suppress"]
+
+    rc, payload = _run_cli(
+        capsys, [str(target), "--baseline", str(baseline)]
+    )
+    assert rc == 0 and payload["findings"] == []
+
+    # Unrelated edit: the finding moves two lines down; fingerprint holds.
+    target.write_text("# moved\n# down\n" + _RAW_PSUM)
+    rc, payload = _run_cli(
+        capsys, [str(target), "--baseline", str(baseline)]
+    )
+    assert rc == 0 and payload["findings"] == []
+
+    # A NEW rule violation in the same file is not masked by the baseline.
+    target.write_text(_RAW_PSUM + "def g(h):\n"
+                      "    return jax.lax.psum(h, 'model')\n")
+    rc, payload = _run_cli(
+        capsys, [str(target), "--baseline", str(baseline)]
+    )
+    assert rc == 1
+    assert {f["symbol"] for f in payload["findings"]} == {"g"}
+
+
+def test_cli_bad_baseline_is_usage_error(tmp_path, capsys):
+    bad = tmp_path / "b.json"
+    bad.write_text('{"wrong": true}')
+    rc = dplint_main([str(tmp_path), "--baseline", str(bad)])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "bad --baseline" in captured.err
+
+
+def test_no_jaxpr_skips_step_hook_module_import(tmp_path, capsys):
+    """--no-jaxpr must not execute DPLINT_LOCAL_STEP-only fixture modules:
+    a broken/expensive fixture import cannot crash a pass that was
+    explicitly disabled."""
+    (tmp_path / "boom.py").write_text(
+        "raise RuntimeError('must not import under --no-jaxpr')\n"
+        "def DPLINT_LOCAL_STEP():\n"
+        "    pass\n"
+    )
+    rc, payload = _run_cli(capsys, [str(tmp_path), "--no-jaxpr"])
+    assert rc == 0 and payload["findings"] == []
+
+
+def test_write_baseline_refresh_in_place_keeps_entries(tmp_path, capsys):
+    """`--baseline ci.json --write-baseline ci.json` (the natural refresh)
+    must re-record still-present findings, not empty the file."""
+    target = tmp_path / "legacy.py"
+    target.write_text(_RAW_PSUM)
+    baseline = tmp_path / "ci.json"
+    assert dplint_main([str(target), "--write-baseline", str(baseline)]) == 0
+    capsys.readouterr()
+    assert len(json.loads(baseline.read_text())["suppress"]) == 1
+
+    rc = dplint_main([str(target), "--baseline", str(baseline),
+                      "--write-baseline", str(baseline)])
+    capsys.readouterr()
+    assert rc == 0
+    assert len(json.loads(baseline.read_text())["suppress"]) == 1
+
+
+def test_write_baseline_refuses_partial_findings(tmp_path, capsys):
+    """An internal error mid-run must not persist a truncated baseline."""
+    (tmp_path / "bad.py").write_text(_RAW_PSUM)
+    (tmp_path / "boom.py").write_text(
+        "raise RuntimeError('explodes')\n"
+        "def DPLINT_LOCAL_STEP():\n"
+        "    pass\n"
+    )
+    baseline = tmp_path / "ci.json"
+    rc = dplint_main([str(tmp_path), "--write-baseline", str(baseline)])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert not baseline.exists()
+    assert "refusing to write baseline" in captured.err
+
+
+def test_fingerprint_distinguishes_same_named_files_outside_repo(tmp_path):
+    from tpu_dp.analysis.report import Finding, fingerprint
+
+    a = Finding("DP103", str(tmp_path / "a" / "steps.py"), 3, "m", symbol="f")
+    b = Finding("DP103", str(tmp_path / "b" / "steps.py"), 3, "m", symbol="f")
+    assert fingerprint(a) != fingerprint(b)

@@ -4,11 +4,13 @@
 Thin launcher around `tpu_dp.analysis` so the tool runs from a checkout
 without installing the package:
 
-    tools/dplint.py                  # analyze the tpu_dp package (both levels)
-    tools/dplint.py --no-jaxpr path  # AST rules only
+    tools/dplint.py                    # all three levels over tpu_dp/
+    tools/dplint.py --no-jaxpr --no-hlo path   # AST rules only (pre-commit)
+    tools/dplint.py --baseline ci.json # suppress pre-existing findings
     tools/dplint.py --list-rules
 
-Equivalent to `python -m tpu_dp.analysis`. Exit 0 clean / 1 findings.
+Equivalent to `python -m tpu_dp.analysis`. Exit 0 clean / 1 findings /
+2 internal or usage error (partial findings still rendered on stdout).
 """
 
 import os

@@ -4,9 +4,13 @@
 #
 #   tools/run_tier1.sh               # full tier-1 suite (CPU backend)
 #   tools/run_tier1.sh --resilience  # fast lane: only -m resilience tests
-#   tools/run_tier1.sh --dplint      # static-analysis lane: dplint over
-#                                    # tpu_dp/ + the -m analysis tests;
-#                                    # fails on any unsuppressed finding
+#   tools/run_tier1.sh --dplint      # static-analysis lane: all three
+#                                    # dplint levels (AST + jaxpr + compiled
+#                                    # HLO) over tpu_dp/ + the -m analysis
+#                                    # tests; fails on any unsuppressed
+#                                    # finding. Emits artifacts/
+#                                    # dplint_report.json and artifacts/
+#                                    # collective_fingerprint.json.
 #
 # Exit code is pytest's; the DOTS_PASSED line echoes the pass count the
 # roadmap tracks across PRs.
@@ -21,7 +25,18 @@ if [ "${1:-}" = "--resilience" ]; then
 fi
 
 if [ "${1:-}" = "--dplint" ]; then
-    env JAX_PLATFORMS=cpu python -m tpu_dp.analysis tpu_dp/ || exit 1
+    # Level 3 included: the JSON findings report and the collective-schedule
+    # fingerprint are CI artifacts (the fingerprint diff across commits is
+    # the review record of any compiled-schedule change).
+    mkdir -p artifacts
+    env JAX_PLATFORMS=cpu python -m tpu_dp.analysis tpu_dp/ --json \
+        --fingerprint-out artifacts/collective_fingerprint.json \
+        > artifacts/dplint_report.json
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        cat artifacts/dplint_report.json
+        exit "$rc"
+    fi
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m analysis \
         -p no:cacheprovider
 fi

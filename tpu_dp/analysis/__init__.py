@@ -1,18 +1,26 @@
 """dplint — static SPMD-correctness analysis for tpu_dp.
 
-Two levels (`docs/ANALYSIS.md` has the full rule table and examples):
+Three levels (`docs/ANALYSIS.md` has the full rule table and examples):
 
-- **Level 1, AST (DP1xx)**: lexical rules over the package source —
+- **Level 1, AST (DP1xx + DP305)**: lexical rules over the package source —
   collectives under rank gates (DP101), host nondeterminism in device code
   (DP102), raw collectives bypassing the typed wrappers (DP103), host
-  syncs in the hot step (DP104) — with `# dplint: allow(RULE)` pragma
-  suppression.
+  syncs in the hot step (DP104), retrace hazards at the jit boundary
+  (DP305) — with `# dplint: allow(RULE)` pragma suppression.
 - **Level 2, jaxpr (DP2xx)**: the gradient-sync verifier — traces the real
   per-shard train step on abstract values and proves every parameter
   leaf's gradient is reduced over the ``data`` axis exactly once per
   optimizer update (DP201 unreduced / DP202 double-reduced, correct under
   gradient accumulation), over axes the mesh actually defines (DP203) —
   plus the donated-buffer read-after-donation check (DP204).
+- **Level 3, HLO (DP3xx)**: the compiled-artifact verifier
+  (`tpu_dp.analysis.hlo`) — lowers and compiles the shipped step programs
+  on an abstract data mesh and checks the optimized HLO: collective
+  classification (DP301), host transfers in the hot loop (DP302),
+  donation surviving as `input_output_alias` (DP303), and the
+  collective-schedule fingerprint (DP304, with a cross-rank startup
+  comparison hook in `tpu_dp.parallel.dist`). `tpu_dp.analysis.recompile`
+  adds the runtime `RecompileGuard` behind DP305's static half.
 
 CLI: ``python -m tpu_dp.analysis [paths...]`` or ``tools/dplint.py``;
 CI lane: ``tools/run_tier1.sh --dplint``.
@@ -21,27 +29,37 @@ CI lane: ``tools/run_tier1.sh --dplint``.
 from tpu_dp.analysis.astlint import lint_file, lint_paths, lint_source
 from tpu_dp.analysis.cli import main
 from tpu_dp.analysis.donation import check_paths as check_donation
-from tpu_dp.analysis.report import RULES, Finding
+from tpu_dp.analysis.recompile import RecompileError, RecompileGuard
+from tpu_dp.analysis.report import RULES, Finding, fingerprint
 
 __all__ = [
     "Finding",
     "RULES",
+    "RecompileError",
+    "RecompileGuard",
     "check_donation",
+    "fingerprint",
     "lint_file",
     "lint_paths",
     "lint_source",
     "main",
     "verify_local_step",
+    "verify_repo_hlo",
     "verify_repo_step",
 ]
 
 
 def __getattr__(name):
-    # gradsync imports jax; keep `import tpu_dp.analysis` light for pure
-    # AST consumers (editors, pre-commit) by loading it on first use.
+    # gradsync/hlo import jax; keep `import tpu_dp.analysis` light for pure
+    # AST consumers (editors, pre-commit) by loading them on first use.
     if name in ("verify_local_step", "verify_repo_step",
                 "reduction_report"):
         from tpu_dp.analysis import gradsync
 
         return getattr(gradsync, name)
+    if name in ("verify_repo_hlo", "program_fingerprint",
+                "count_collectives", "schedule_digest"):
+        from tpu_dp.analysis import hlo
+
+        return getattr(hlo, name)
     raise AttributeError(name)

@@ -81,6 +81,38 @@ def _dotted(node: ast.AST) -> str | None:
     return None
 
 
+def scope_index(tree: ast.Module) -> list[tuple[int, int, str]]:
+    """(start_line, end_line, qualname) for every def/class in a module.
+
+    The qualname is the finding ``symbol`` — the stable identity baseline
+    suppression keys on (a finding moves with its function, not its line).
+    """
+    out: list[tuple[int, int, str]] = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                out.append((child.lineno, child.end_lineno or child.lineno, q))
+                visit(child, q)
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def scope_at(index: list[tuple[int, int, str]], line: int) -> str:
+    """Qualname of the innermost def/class containing ``line`` ('' = module)."""
+    best, best_span = "", None
+    for start, end, q in index:
+        span = end - start
+        if start <= line <= end and (best_span is None or span <= best_span):
+            best, best_span = q, span
+    return best
+
+
 def _is_collective_call(call: ast.Call) -> str | None:
     """The collective's name if this call is a collective/barrier."""
     dotted = _dotted(call.func)
@@ -172,12 +204,16 @@ class _Linter:
         self.source = source
         self.allowed = pragmas.collect(source)
         self.findings: list[Finding] = []
+        self._scopes: list[tuple[int, int, str]] = []
 
     def _emit(self, rule: str, line: int, message: str,
               extra_lines: tuple[int, ...] = ()) -> None:
         if pragmas.is_allowed(self.allowed, rule, (line,) + extra_lines):
             return
-        self.findings.append(Finding(rule, self.path, line, message))
+        self.findings.append(Finding(
+            rule, self.path, line, message,
+            symbol=scope_at(self._scopes, line),
+        ))
 
     def run(self) -> list[Finding]:
         try:
@@ -188,6 +224,7 @@ class _Linter:
                 f"file does not parse: {e.msg}",
             ))
             return self.findings
+        self._scopes = scope_index(tree)
         in_collectives_module = self.path.replace(os.sep, "/").endswith(
             "parallel/collectives.py"
         )

@@ -1,18 +1,28 @@
 """dplint CLI: `python -m tpu_dp.analysis [paths...]` / `tools/dplint.py`.
 
-Runs the Level-1 AST lint (DP101–DP104) and the donation check (DP204)
-over the given paths, then — unless `--no-jaxpr` — the Level-2 jaxpr
-gradient-sync pass (DP201–DP203):
+Runs three levels over the given paths:
 
-- when the analyzed tree contains the shipped step factory
+- **Level 1 (AST)**: DP101–DP104, the donation check (DP204), and the
+  retrace-hazard lint (DP305). No jax import.
+- **Level 2 (jaxpr, unless --no-jaxpr)**: the gradient-sync pass
+  (DP201–DP203). When the analyzed tree contains the shipped step factory
   (`tpu_dp/train/step.py`), the real per-shard step is traced and verified
-  for every `--accum-steps` variant;
-- a standalone .py path that defines `DPLINT_LOCAL_STEP` (a zero-arg
-  factory returning ``(fn, example_args)`` and optionally a world size) is
-  imported and its step verified — how the adversarial test fixtures are
-  driven through the exact same pipeline as the real code.
+  for every `--accum-steps` variant; a standalone .py defining
+  `DPLINT_LOCAL_STEP` is imported and its step verified the same way.
+- **Level 3 (HLO, unless --no-hlo)**: the compiled-artifact pass
+  (DP301–DP304). The shipped step programs are lowered and compiled on an
+  abstract `--world`-device data mesh and the optimized HLO is verified
+  (collective classification, host transfers, input_output_alias, schedule
+  fingerprint — the fingerprint artifact lands at `--fingerprint-out`);
+  a standalone .py defining `DPLINT_HLO_PROGRAM` rides the same pipeline.
 
-Exit codes: 0 clean, 1 findings, 2 internal error. The tier-1 CI lane
+Exit codes: 0 clean, 1 findings, 2 internal/usage error. On an internal
+error the findings already collected are still rendered to stdout (marked
+partial) and the traceback goes to stderr, so `--json` output stays
+machine-parseable. `--baseline FILE` suppresses findings by stable
+fingerprint (rule+path+symbol — never line numbers), letting CI adopt new
+rules without blocking on pre-existing findings; `--write-baseline FILE`
+records the current findings as that file. The tier-1 CI lane
 (`tools/run_tier1.sh --dplint`) fails on any unsuppressed finding.
 """
 
@@ -24,72 +34,120 @@ import importlib.util
 import os
 import sys
 
-from tpu_dp.analysis import astlint, donation
+from tpu_dp.analysis import astlint, donation, recompile
 from tpu_dp.analysis.report import (
     Finding,
+    apply_baseline,
     list_rules,
+    load_baseline,
     render_json,
     render_text,
+    write_baseline,
 )
 
 _STEP_HOOK = "DPLINT_LOCAL_STEP"
+_HLO_HOOK = "DPLINT_HLO_PROGRAM"
 
 
-def _defines_step_hook(path: str, source: str) -> bool:
+def _module_hooks(path: str, source: str) -> set[str]:
+    """Which dplint hooks (`DPLINT_*`) a file defines at top level."""
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError:
-        return False
+        return set()
+    hooks: set[str] = set()
+    wanted = {_STEP_HOOK, _HLO_HOOK}
     for node in tree.body:
         if isinstance(node, (ast.Assign, ast.AnnAssign)):
             targets = node.targets if isinstance(node, ast.Assign) else [
                 node.target
             ]
             for t in targets:
-                if isinstance(t, ast.Name) and t.id == _STEP_HOOK:
-                    return True
+                if isinstance(t, ast.Name) and t.id in wanted:
+                    hooks.add(t.id)
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if node.name == _STEP_HOOK:
-                return True
-    return False
+            if node.name in wanted:
+                hooks.add(node.name)
+    return hooks
 
 
-def _verify_step_hook(path: str, world: int) -> list[Finding]:
-    from tpu_dp.analysis import gradsync
-
+def _load_module(path: str):
     name = "_dplint_fixture_" + os.path.splitext(os.path.basename(path))[0]
     spec = importlib.util.spec_from_file_location(name, path)
     module = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(module)
+    return module
+
+
+def _verify_step_hook(path: str, module, world: int) -> list[Finding]:
+    from tpu_dp.analysis import gradsync
+
     hook = getattr(module, _STEP_HOOK)
     built = hook() if callable(hook) else hook
     fn, example_args = built[0], built[1]
     hook_world = built[2] if len(built) > 2 else world
     findings, _ = gradsync.verify_local_step(
-        fn, example_args, world=hook_world, where=(path, fn.__code__.co_firstlineno),
+        fn, example_args, world=hook_world,
+        where=(path, fn.__code__.co_firstlineno),
         label=f"{_STEP_HOOK} in {os.path.basename(path)}",
     )
     return findings
+
+
+def _setup_backend(world: int) -> None:
+    """Pin the analysis backend: CPU with ``world`` virtual devices.
+
+    Must run before the first jax backend initialization; in-process
+    callers (pytest via conftest) have already done the same trick. When
+    the user explicitly targets a real platform (JAX_PLATFORMS set), it is
+    left alone.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={world}"
+        ).strip()
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The build environment's sitecustomize pre-imports jax under a TPU
+        # plugin; the env var alone is too late for it.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="dplint",
         description="static SPMD-correctness analyzer for tpu_dp "
-                    "(collective-deadlock + gradient-sync verifier)",
+                    "(collective-deadlock, gradient-sync, and compiled-"
+                    "artifact verifier)",
     )
     parser.add_argument("paths", nargs="*", default=None,
                         help="files or directories to analyze "
                              "(default: the tpu_dp package)")
     parser.add_argument("--no-jaxpr", action="store_true",
                         help="skip the Level-2 jaxpr gradient-sync pass")
+    parser.add_argument("--no-hlo", action="store_true",
+                        help="skip the Level-3 compiled-HLO pass")
     parser.add_argument("--accum-steps", default="1,2",
                         help="comma-separated accum_steps variants the "
-                             "jaxpr pass verifies (default: 1,2)")
+                             "jaxpr/HLO passes verify (default: 1,2)")
     parser.add_argument("--world", type=int, default=8,
-                        help="abstract data-axis size for tracing")
+                        help="abstract data-axis size for tracing/lowering")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable output")
+    parser.add_argument("--baseline", default=None, metavar="FILE",
+                        help="suppress findings whose fingerprint "
+                             "(rule+path+symbol) appears in FILE")
+    parser.add_argument("--write-baseline", default=None, metavar="FILE",
+                        help="write the current findings' fingerprints to "
+                             "FILE and exit 0")
+    parser.add_argument("--fingerprint-out", default=None, metavar="FILE",
+                        help="where the Level-3 collective-schedule "
+                             "fingerprint artifact lands (default: "
+                             "<repo>/artifacts/collective_fingerprint.json; "
+                             "'none' disables)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule table and exit")
     args = parser.parse_args(argv)
@@ -98,45 +156,147 @@ def main(argv: list[str] | None = None) -> int:
         print(list_rules())
         return 0
 
+    # Usage errors are diagnosed before any analysis runs: a clean message
+    # on stderr and exit 2, never a traceback dressed as an internal error.
+    try:
+        accum_variants = _parse_accum(args.accum_steps)
+    except ValueError as e:
+        print(f"dplint: bad --accum-steps: {e}", file=sys.stderr)
+        return 2
+    suppressed: set[str] = set()
+    if args.baseline is not None:
+        try:
+            suppressed = load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"dplint: bad --baseline: {e}", file=sys.stderr)
+            return 2
+
     paths = args.paths or [os.path.join(_repo_root(), "tpu_dp")]
 
+    findings: list[Finding] = []
+    internal_error: str | None = None
     try:
-        # One read per file; AST lint, donation check, and hook discovery
-        # all work from the same source text.
+        # One read per file; AST lint, donation check, retrace lint, and
+        # hook discovery all work from the same source text.
         files = astlint.iter_py_files(paths)
-        findings = []
         sources: dict[str, str] = {}
+        hooks: dict[str, set[str]] = {}
         for f in files:
             with open(f, encoding="utf-8") as fh:
                 sources[f] = fh.read()
             findings.extend(astlint.lint_source(f, sources[f]))
             findings.extend(donation.check_source(f, sources[f]))
+            findings.extend(recompile.lint_source(f, sources[f]))
+            hooks[f] = _module_hooks(f, sources[f])
+
+        has_repo_step = any(
+            f.replace(os.sep, "/").endswith("tpu_dp/train/step.py")
+            for f in files
+        )
+
+        # A hook module is imported only when a pass that consumes it will
+        # actually run: --no-jaxpr must skip DPLINT_LOCAL_STEP-only files
+        # entirely (not execute their import and crash), and likewise
+        # --no-hlo for DPLINT_HLO_PROGRAM-only files.
+        def _wanted(f: str) -> bool:
+            return ((not args.no_jaxpr and _STEP_HOOK in hooks[f])
+                    or (not args.no_hlo and _HLO_HOOK in hooks[f]))
+
+        modules: dict[str, object] = {}
+        if (not (args.no_jaxpr and args.no_hlo) and has_repo_step) or any(
+            _wanted(f) for f in files
+        ):
+            _setup_backend(args.world)
+            modules = {f: _load_module(f) for f in files if _wanted(f)}
 
         if not args.no_jaxpr:
-            # The jaxpr pass imports jax; a TPU-attached default backend is
-            # pointless for abstract tracing, so pin CPU unless overridden.
-            os.environ.setdefault("JAX_PLATFORMS", "cpu")
-            if any(f.replace(os.sep, "/").endswith("tpu_dp/train/step.py")
-                   for f in files):
+            if has_repo_step:
                 from tpu_dp.analysis import gradsync
 
-                for accum in _parse_accum(args.accum_steps):
+                for accum in accum_variants:
                     got, _ = gradsync.verify_repo_step(
                         accum_steps=accum, world=args.world
                     )
                     findings.extend(got)
             for f in files:
-                if _defines_step_hook(f, sources[f]):
-                    findings.extend(_verify_step_hook(f, args.world))
-    except Exception:
+                if _STEP_HOOK in hooks[f]:
+                    findings.extend(
+                        _verify_step_hook(f, modules[f], args.world)
+                    )
+
+        if not args.no_hlo:
+            findings.extend(_run_hlo_pass(
+                args, files, hooks, modules, has_repo_step, accum_variants,
+            ))
+    except Exception as e:
         import traceback
 
         traceback.print_exc()
-        print("dplint: internal error", file=sys.stderr)
-        return 2
+        print("dplint: internal error (partial findings on stdout)",
+              file=sys.stderr)
+        internal_error = f"{type(e).__name__}: {e}"
 
-    print(render_json(findings) if args.json else render_text(findings))
+    # The baseline is written from the PRE-suppression findings: the
+    # natural in-place refresh `--baseline ci.json --write-baseline ci.json`
+    # must re-record the still-present findings, not empty the file.
+    all_findings = findings
+    findings = apply_baseline(findings, suppressed)
+
+    if args.write_baseline is not None:
+        if internal_error:
+            # A truncated run would persist an under-suppressing baseline
+            # that blocks the next healthy run; refuse.
+            print("dplint: refusing to write baseline from partial "
+                  "findings (internal error above)", file=sys.stderr)
+            print(render_json(findings, error=internal_error) if args.json
+                  else render_text(findings, error=internal_error))
+            return 2
+        n = write_baseline(args.write_baseline, all_findings)
+        print(f"dplint: wrote {n} fingerprint(s) to {args.write_baseline}",
+              file=sys.stderr)
+        return 0
+
+    print(render_json(findings, error=internal_error) if args.json
+          else render_text(findings, error=internal_error))
+    if internal_error:
+        return 2
     return 1 if findings else 0
+
+
+def _run_hlo_pass(args, files, hooks, modules, has_repo_step,
+                  accum_variants) -> list[Finding]:
+    """Level 3: compiled-artifact verification (DP301–DP304)."""
+    if not has_repo_step and not any(_HLO_HOOK in h for h in hooks.values()):
+        return []
+    import jax
+
+    from tpu_dp.analysis import hlo
+
+    if len(jax.devices()) < 2:
+        # A 1-device backend compiles away every collective: DP301 would
+        # report the gradient all-reduce missing on a correct program.
+        print("dplint: skipping Level-3 HLO pass (backend has "
+              f"{len(jax.devices())} device(s); needs >= 2 — run before "
+              "jax initializes or pass XLA_FLAGS="
+              "--xla_force_host_platform_device_count=8)", file=sys.stderr)
+        return []
+
+    findings: list[Finding] = []
+    if has_repo_step:
+        got, artifact = hlo.verify_repo_hlo(
+            accum_steps=accum_variants, world=args.world
+        )
+        findings.extend(got)
+        out = args.fingerprint_out
+        if out is None:
+            out = os.path.join(_repo_root(), "artifacts",
+                               "collective_fingerprint.json")
+        if out and out.lower() != "none":
+            hlo.write_fingerprint_artifact(out, artifact)
+    for f in files:
+        if _HLO_HOOK in hooks[f]:
+            findings.extend(hlo.verify_hlo_hook(f, modules[f], args.world))
+    return findings
 
 
 def _parse_accum(spec: str) -> list[int]:

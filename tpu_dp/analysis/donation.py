@@ -24,7 +24,8 @@ import ast
 from typing import Iterable
 
 from tpu_dp.analysis import pragmas
-from tpu_dp.analysis.astlint import _dotted, iter_py_files
+from tpu_dp.analysis.astlint import _dotted, iter_py_files, scope_index, \
+    scope_at
 from tpu_dp.analysis.report import Finding
 
 # Factories returning a step jitted with donate_argnums=(0,): calling the
@@ -35,6 +36,12 @@ DONATING_FACTORIES = {
     "make_multi_step_resident",
     "make_train_step_shard_map",
 }
+
+# Wrappers that preserve the donating call signature: a name bound to
+# `RecompileGuard(make_train_step(...))` or the trainer's
+# `self._guarded("train_step", make_train_step(...))` still donates its
+# first argument when called.
+_TRANSPARENT_WRAPPERS = {"RecompileGuard", "_guarded"}
 
 
 def _target_names(target: ast.AST) -> list[str]:
@@ -58,6 +65,13 @@ def _collect_step_fn_names(tree: ast.Module) -> set[str]:
         if not isinstance(value, ast.Call):
             continue
         dotted = _dotted(value.func)
+        if dotted and dotted.rsplit(".", 1)[-1] in _TRANSPARENT_WRAPPERS:
+            inner = next(
+                (a for a in value.args if isinstance(a, ast.Call)), None
+            )
+            if inner is not None:
+                value = inner
+                dotted = _dotted(value.func)
         if dotted and dotted.rsplit(".", 1)[-1] in DONATING_FACTORIES:
             for target in node.targets:
                 names.update(_target_names(target))
@@ -83,6 +97,7 @@ def _check_scope(
     step_fns: set[str],
     path: str,
     allowed: dict[int, set[str]],
+    scopes: list[tuple[int, int, str]] | None = None,
 ) -> list[Finding]:
     # (donated_name, donation_line, donation_end_line) events and
     # (name, line) stores/loads, all in source-line order — the
@@ -139,6 +154,7 @@ def _check_scope(
                     f"{dline} (donate_argnums) and read afterwards — its "
                     f"buffers now belong to XLA; rebind the step's result "
                     f"to `{name}` instead",
+                    symbol=scope_at(scopes, lline) if scopes else "",
                 ))
     return findings
 
@@ -152,13 +168,14 @@ def check_source(path: str, source: str) -> list[Finding]:
     if not step_fns:
         return []
     allowed = pragmas.collect(source)
+    index = scope_index(tree)
     findings: list[Finding] = []
     scopes: list[ast.AST] = [
         node for node in ast.walk(tree)
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
     ]
     for scope in scopes:
-        findings.extend(_check_scope(scope, step_fns, path, allowed))
+        findings.extend(_check_scope(scope, step_fns, path, allowed, index))
     return findings
 
 

@@ -201,6 +201,7 @@ def verify_local_step(
                 "DP203", path, line,
                 f"{label}: collective over unknown mesh axis {bad_axis!r} — "
                 f"the mesh defines only {axis!r}",
+                symbol=label,
             )], {}
         raise
     findings: list[Finding] = []
@@ -211,6 +212,7 @@ def verify_local_step(
                 f"{label}: gradient of {ks} is never reduced over the "
                 f"{axis!r} axis — replicas train on local shards and "
                 f"silently diverge",
+                symbol=label,
             ))
         elif count > 1 and exact:
             findings.append(Finding(
@@ -218,6 +220,7 @@ def verify_local_step(
                 f"{label}: gradient of {ks} is reduced {count}× over the "
                 f"{axis!r} axis — repeated averaging silently rescales "
                 f"the update",
+                symbol=label,
             ))
     return findings, report
 

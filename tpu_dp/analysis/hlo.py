@@ -1,0 +1,537 @@
+"""Level-3 dplint: verify the compiled XLA artifact (DP301–DP304).
+
+Levels 1–2 prove the *source* and the *trace*; the properties the DDP-parity
+claim actually rests on are decided later, by the GSPMD partitioner and the
+XLA compiler: whether the gradient all-reduce is one combinable group or a
+mess of reshards, whether ``donate_argnums`` survived as a real
+``input_output_alias`` (XLA drops aliasing with only a warning, silently
+doubling parameter memory), whether a host callback snuck into the hot loop.
+This pass lowers the *real shipped step programs* (`tpu_dp.train.step`) on an
+abstract data mesh, compiles them, and verifies the optimized HLO text:
+
+- **DP301** — every collective in the module is classified. A DP train step
+  must compile to exactly one *combinable* gradient all-reduce group
+  (non-scalar operands, identical full-mesh replica groups, add reduction —
+  XLA's combiner pass fuses such a group into the single fused all-reduce on
+  TPU; the CPU backend leaves the ops separate, so the check is on
+  combinability, not op count) plus the declared scalar metric reductions.
+  Any all-gather / reduce-scatter / collective-permute / all-to-all, any
+  second replica grouping, and any extra scalar reduction betrays a bad
+  `PartitionSpec` in `parallel/sharding.py`.
+- **DP302** — host transfers in the hot loop: infeed/outfeed/send/recv ops
+  or host-callback custom-calls inside the step module.
+- **DP303** — donation silently dropped: every donated buffer must appear
+  in the compiled module's ``input_output_alias`` map.
+- **DP304** — collective-schedule fingerprint: a deterministic digest of the
+  ordered collective sequence + replica groups, emitted to
+  ``artifacts/collective_fingerprint.json``; `tpu_dp.parallel.dist`
+  cross-compares digests across ranks at startup so desynced binaries fail
+  fast instead of deadlocking mid-step.
+
+A standalone .py file can opt in by defining ``DPLINT_HLO_PROGRAM`` — a
+zero-arg factory returning a dict with keys ``fn`` (callable to jit),
+``args`` (example arguments), and optionally ``jit_kwargs``,
+``metric_reductions``, ``expect_grad_reduce``, ``expect_fingerprint`` —
+which is how the adversarial fixtures drive the exact pipeline the shipped
+steps go through.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import time
+import warnings
+from typing import Any, Callable, Sequence
+
+from tpu_dp.analysis.report import Finding
+
+# Collective/host ops as they appear in optimized HLO text. "-start" forms
+# (async collectives on TPU) count as the op; "-done" halves are skipped so
+# an async pair is one collective, not two.
+_COLLECTIVE_KINDS = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+_HOST_KINDS = ("infeed", "outfeed", "send", "recv")
+
+_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%[\w.~-]+\s*=\s*(\([^)]*\)|\S+)\s+([a-z-]+)\("
+)
+_REPLICA_GROUPS_RE = re.compile(
+    r"replica_groups=(\[[^\]]*\]<=\[[^\]]*\](?:T\([\d,]+\))?"
+    r"|\{\{[\d,]*\}(?:,\{[\d,]*\})*\})"
+)
+_TO_APPLY_RE = re.compile(r"to_apply=%([\w.~-]+)")
+_TARGET_RE = re.compile(r'custom_call_target="([^"]+)"')
+_ALIAS_RE = re.compile(r"input_output_alias=\{(.*?)\}(?:,\s*[a-z_]+=|\s*$)")
+_ALIAS_ENTRY_RE = re.compile(r"\{[\d,\s]*\}:\s*\((\d+),")
+_LAYOUT_RE = re.compile(r"\{[\d,*]*\}")
+
+# custom_call_target substrings that mean "the compiled program calls back
+# into the host" (CPU/TPU python callbacks, explicit host transfers).
+_HOST_TARGET_MARKERS = ("callback", "host", "infeed", "outfeed")
+
+
+@dataclasses.dataclass(frozen=True)
+class HloOp:
+    """One collective or host-transfer op in a compiled module."""
+
+    kind: str            # "all-reduce", "all-gather", ..., "custom-call"
+    shape: str           # layout-stripped result shape, e.g. "f32[120,400]"
+    replica_groups: str  # raw replica_groups text ("" when absent)
+    reduction: str       # root op of to_apply ("add", "maximum", ...; "")
+    target: str          # custom_call_target ("" for non-custom-calls)
+
+    @property
+    def is_scalar(self) -> bool:
+        # A rank-0 result (or tuple of rank-0s): "f32[]", "(f32[], s32[])".
+        return "[" in self.shape and "[]" in self.shape and not re.search(
+            r"\[\d", self.shape
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _computation_reductions(text: str) -> dict[str, str]:
+    """Map computation name -> its ROOT op (the reduction kind)."""
+    out: dict[str, str] = {}
+    name = None
+    for line in text.splitlines():
+        m = re.match(r"^(?:ENTRY\s+)?%([\w.~-]+)\s*\(", line)
+        if m:
+            name = m.group(1)
+            continue
+        if name and "ROOT" in line:
+            r = re.search(r"ROOT\s+%[\w.~-]+\s*=\s*(?:\([^)]*\)|\S+)\s+"
+                          r"([a-z-]+)\(", line)
+            if r:
+                out[name] = r.group(1)
+    return out
+
+
+def collect_ops(text: str) -> list[HloOp]:
+    """Every collective/host op in a compiled module, in schedule order.
+
+    Compiled HLO is scheduled (`is_scheduled=true`), so the textual order of
+    the entry computation *is* the execution order — the property the DP304
+    fingerprint digests. Ops inside nested computations (loop bodies) appear
+    once, i.e. the fingerprint is the static schedule.
+    """
+    reductions = _computation_reductions(text)
+    ops: list[HloOp] = []
+    for line in text.splitlines():
+        m = _OP_RE.match(line)
+        if m is None:
+            continue
+        shape, kind = m.groups()
+        if kind.endswith("-done"):
+            continue  # the async pair's completion; counted at -start
+        base = kind[:-6] if kind.endswith("-start") else kind
+        if base not in _COLLECTIVE_KINDS and base not in _HOST_KINDS \
+                and base != "custom-call":
+            continue
+        rg = _REPLICA_GROUPS_RE.search(line)
+        ta = _TO_APPLY_RE.search(line)
+        tgt = _TARGET_RE.search(line)
+        ops.append(HloOp(
+            kind=base,
+            shape=_LAYOUT_RE.sub("", shape).replace(" ", ""),
+            replica_groups=rg.group(1) if rg else "",
+            reduction=reductions.get(ta.group(1), "") if ta else "",
+            target=tgt.group(1) if tgt else "",
+        ))
+    return ops
+
+
+def count_collectives(text: str) -> dict[str, int]:
+    """Collective-op histogram of a compiled module (bench/report stat)."""
+    counts: dict[str, int] = {}
+    for op in collect_ops(text):
+        if op.kind in _COLLECTIVE_KINDS:
+            counts[op.kind] = counts.get(op.kind, 0) + 1
+    return counts
+
+
+def alias_param_indices(text: str) -> set[int]:
+    """Parameter indices the compiled module aliases to outputs."""
+    m = _ALIAS_RE.search(text.splitlines()[0] if text else "")
+    if m is None:
+        m = _ALIAS_RE.search(text)
+    if m is None:
+        return set()
+    return {int(i) for i in _ALIAS_ENTRY_RE.findall(m.group(1))}
+
+
+def schedule_digest(ops: Sequence[HloOp]) -> str:
+    """Deterministic sha256 over the ordered collective schedule."""
+    canon = [
+        {"kind": op.kind, "shape": op.shape,
+         "replica_groups": op.replica_groups, "reduction": op.reduction}
+        for op in ops if op.kind in _COLLECTIVE_KINDS
+    ]
+    return hashlib.sha256(
+        json.dumps(canon, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def lower_and_compile(jitted: Callable, args: Sequence[Any]):
+    """AOT lower+compile; returns (hlo_text, stats, lowering_warnings).
+
+    ``stats``: lowering/compile wall times in ms (what `bench.py` reports as
+    compile stats). Warnings matching XLA's dropped-donation message are
+    captured for DP303's diagnostics instead of leaking to the console.
+    """
+    caught: list[str] = []
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        t0 = time.perf_counter()
+        lowered = jitted.lower(*args)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+    for item in w:
+        msg = str(item.message)
+        if "donated" in msg.lower():
+            caught.append(msg.splitlines()[0])
+        else:
+            warnings.warn_explicit(item.message, item.category,
+                                   item.filename, item.lineno)
+    stats = {
+        "lowering_ms": round((t1 - t0) * 1e3, 2),
+        "compile_ms": round((t2 - t1) * 1e3, 2),
+    }
+    return compiled.as_text(), stats, caught
+
+
+def analyze_module(
+    text: str,
+    *,
+    label: str,
+    where: tuple[str, int],
+    world: int,
+    donated_leaves: int = 0,
+    metric_reductions: int = 0,
+    expect_grad_reduce: bool = False,
+    expect_fingerprint: str | None = None,
+    donation_warnings: Sequence[str] = (),
+) -> tuple[list[Finding], dict]:
+    """Run DP301–DP304 over one compiled module's text.
+
+    Returns (findings, record) where the record is the program's entry in
+    the collective-fingerprint artifact.
+    """
+    path, line = where
+    findings: list[Finding] = []
+    ops = collect_ops(text)
+    collectives = [op for op in ops if op.kind in _COLLECTIVE_KINDS]
+
+    def emit(rule: str, message: str) -> None:
+        findings.append(Finding(rule, path, line, f"{label}: {message}",
+                                symbol=label))
+
+    # -- DP301: classify every collective --------------------------------
+    bad_kinds = [op for op in collectives if op.kind != "all-reduce"]
+    for op in bad_kinds:
+        emit("DP301",
+             f"compiled program contains `{op.kind}` {op.shape} "
+             f"(replica_groups={op.replica_groups or '?'}) — a pure-DP step "
+             f"needs no {op.kind}; an extra collective here means a batch "
+             f"or parameter dimension is sharded/replicated against the "
+             f"declared PartitionSpec (parallel/sharding.py)")
+    allreduces = [op for op in collectives if op.kind == "all-reduce"]
+    grad_ars = [op for op in allreduces if not op.is_scalar]
+    metric_ars = [op for op in allreduces if op.is_scalar]
+    groups = {op.replica_groups for op in allreduces}
+    if len(groups) > 1:
+        emit("DP301",
+             f"all-reduces use {len(groups)} distinct replica groupings "
+             f"({sorted(groups)}) — the data-parallel step has one axis, so "
+             f"every reduction must span the same full-mesh group")
+    non_add = sorted({op.reduction for op in grad_ars
+                      if op.reduction and op.reduction != "add"})
+    if non_add:
+        emit("DP301",
+             f"gradient all-reduce group mixes reduction kinds "
+             f"(add + {non_add}) — a non-add reduction on the gradient path "
+             f"cannot fuse into the single combined all-reduce")
+    if expect_grad_reduce and world > 1 and not grad_ars:
+        emit("DP301",
+             "no non-scalar all-reduce in the compiled train step — the "
+             "gradient all-reduce the DDP contract requires was never "
+             "materialized by the partitioner (replicas would silently "
+             "diverge)")
+    if len(metric_ars) > metric_reductions:
+        emit("DP301",
+             f"{len(metric_ars)} scalar all-reduce(s) compiled, "
+             f"{metric_reductions} metric reduction(s) declared — an "
+             f"undeclared scalar sync per step serializes the schedule")
+
+    # -- DP302: host transfers in the hot loop ---------------------------
+    for op in ops:
+        if op.kind in _HOST_KINDS:
+            emit("DP302",
+                 f"`{op.kind}` op inside the compiled step — a host "
+                 f"transfer in the hot loop stalls every step on the host "
+                 f"round-trip")
+        elif op.kind == "custom-call" and any(
+            marker in op.target.lower() for marker in _HOST_TARGET_MARKERS
+        ):
+            emit("DP302",
+                 f"host-callback custom-call `{op.target}` inside the "
+                 f"compiled step — debug prints / pure_callbacks compile "
+                 f"into a per-step host round-trip; hoist them out of the "
+                 f"jitted body")
+
+    # -- DP303: donation survived as input_output_alias ------------------
+    aliased = alias_param_indices(text)
+    if donated_leaves:
+        missing = [i for i in range(donated_leaves) if i not in aliased]
+        if missing:
+            why = f" (XLA: {donation_warnings[0]})" if donation_warnings \
+                else ""
+            emit("DP303",
+                 f"{len(missing)} of {donated_leaves} donated buffer(s) "
+                 f"missing from input_output_alias (params "
+                 f"{missing[:8]}{'...' if len(missing) > 8 else ''}) — XLA "
+                 f"dropped the aliasing without error, so those buffers "
+                 f"are double-allocated every step{why}")
+
+    # -- DP304: pinned-fingerprint comparison ----------------------------
+    digest = schedule_digest(ops)
+    if expect_fingerprint is not None and digest != expect_fingerprint:
+        emit("DP304",
+             f"collective-schedule fingerprint {digest[:12]}… does not "
+             f"match the pinned {expect_fingerprint[:12]}… — this binary "
+             f"compiles a different collective sequence than the one "
+             f"recorded; desynced ranks would deadlock mid-step")
+
+    record = {
+        "digest": digest,
+        "collectives": [op.to_dict() for op in collectives],
+        "counts": count_collectives(text),
+        "grad_allreduce_ops": len(grad_ars),
+        "metric_allreduce_ops": len(metric_ars),
+        "donated_inputs": donated_leaves,
+        "aliased_inputs": len(aliased),
+    }
+    return findings, record
+
+
+# --------------------------------------------------------------------------
+# The shipped step programs, lowered on an abstract data mesh.
+# --------------------------------------------------------------------------
+
+def _usable_world(world: int) -> int:
+    import jax
+
+    return min(world, len(jax.devices()))
+
+
+def _step_py_path() -> str:
+    from tpu_dp.train import step
+
+    return step.__file__
+
+
+def _example_batch(batch_size: int, prefix: tuple[int, ...] = ()):
+    import jax.numpy as jnp
+
+    return {
+        "image": jnp.zeros(prefix + (batch_size, 32, 32, 3), jnp.float32),
+        "label": jnp.zeros(prefix + (batch_size,), jnp.int32),
+    }
+
+
+def shipped_programs(
+    accum_steps: Sequence[int] = (1, 2),
+    world: int = 8,
+    model_name: str = "net",
+):
+    """Yield (name, jitted, args, spec) for every shipped step factory.
+
+    ``spec`` carries donated_leaves / metric_reductions /
+    expect_grad_reduce / where for `analyze_module`. Metric reductions per
+    update are the two replicated scalars the step returns: mean loss
+    (f32[]) and the correct-prediction count (s32[]).
+    """
+    import jax
+    import numpy as np
+
+    from tpu_dp.models import build_model
+    from tpu_dp.parallel import dist
+    from tpu_dp.train import step as step_mod
+    from tpu_dp.train.optim import SGD
+    from tpu_dp.train.schedule import constant_lr
+    from tpu_dp.train.state import create_train_state
+
+    world = _usable_world(world)
+    mesh = dist.data_mesh(num_devices=world)
+    model = build_model(model_name)
+    opt = SGD(momentum=0.9)
+    sched = constant_lr(0.1)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
+        opt,
+    )
+    n_state = len(jax.tree_util.tree_leaves(state))
+    batch = 2 * world
+    path = _step_py_path()
+
+    def spec(factory, donated, metrics, grad):
+        return {
+            "donated_leaves": donated,
+            "metric_reductions": metrics,
+            "expect_grad_reduce": grad,
+            "where": (path, factory.__code__.co_firstlineno),
+            "world": world,
+        }
+
+    for accum in accum_steps:
+        prefix = () if accum == 1 else (accum,)
+        yield (
+            f"train_step[gspmd]@accum{accum}",
+            step_mod.make_train_step(model, opt, mesh, sched,
+                                     accum_steps=accum),
+            (state, _example_batch(batch, prefix)),
+            spec(step_mod.make_train_step, n_state, 2, True),
+        )
+    yield (
+        "train_step[shard_map]@accum1",
+        step_mod.make_train_step_shard_map(model, opt, mesh, sched),
+        (state, _example_batch(batch)),
+        spec(step_mod.make_train_step_shard_map, n_state, 2, True),
+    )
+    yield (
+        "multi_step@w2",
+        step_mod.make_multi_step(model, opt, mesh, sched, num_steps=2),
+        (state, _example_batch(batch, (2,))),
+        spec(step_mod.make_multi_step, n_state, 2, True),
+    )
+    yield (
+        "eval_step",
+        step_mod.make_eval_step(model, mesh),
+        (state, _example_batch(batch)),
+        spec(step_mod.make_eval_step, 0, 2, False),
+    )
+
+
+def verify_repo_hlo(
+    accum_steps: Sequence[int] = (1, 2),
+    world: int = 8,
+) -> tuple[list[Finding], dict]:
+    """Compile every shipped step on the abstract mesh; verify DP301–DP304.
+
+    Returns (findings, artifact) where the artifact is the
+    collective-fingerprint record `write_fingerprint_artifact` persists.
+    """
+    import jax
+
+    findings: list[Finding] = []
+    programs: dict[str, dict] = {}
+    usable = _usable_world(world)
+    for name, jitted, args, spec in shipped_programs(accum_steps, world):
+        text, stats, donation_warns = lower_and_compile(jitted, args)
+        got, record = analyze_module(
+            text, label=name, where=spec["where"], world=spec["world"],
+            donated_leaves=spec["donated_leaves"],
+            metric_reductions=spec["metric_reductions"],
+            expect_grad_reduce=spec["expect_grad_reduce"],
+            donation_warnings=donation_warns,
+        )
+        findings.extend(got)
+        record.update(stats)
+        programs[name] = record
+    overall = hashlib.sha256(json.dumps(
+        {k: v["digest"] for k, v in sorted(programs.items())},
+        sort_keys=True,
+    ).encode()).hexdigest()
+    artifact = {
+        "version": 1,
+        "world": usable,
+        "backend": jax.default_backend(),
+        "digest": overall,
+        "programs": programs,
+    }
+    return findings, artifact
+
+
+def write_fingerprint_artifact(path: str, artifact: dict) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def program_fingerprint(jitted: Callable, args: Sequence[Any]) -> str:
+    """Collective-schedule digest of one jitted program (startup hook).
+
+    What `Trainer` feeds `tpu_dp.parallel.dist.verify_collective_fingerprint`
+    when ``train.verify_fingerprint`` is enabled: every rank digests the
+    program it is about to run and rank 0's digest is the reference.
+    """
+    text, _, _ = lower_and_compile(jitted, args)
+    return schedule_digest(collect_ops(text))
+
+
+# --------------------------------------------------------------------------
+# Standalone-file hook: how the adversarial fixtures ride the same pipeline.
+# --------------------------------------------------------------------------
+
+HLO_HOOK = "DPLINT_HLO_PROGRAM"
+
+
+def verify_hlo_hook(path: str, module: Any, world: int) -> list[Finding]:
+    """Compile and verify a file's ``DPLINT_HLO_PROGRAM`` declaration."""
+    import jax
+
+    hook = getattr(module, HLO_HOOK)
+    decl = hook() if callable(hook) else hook
+    fn = decl["fn"]
+    args = decl["args"]
+    jit_kwargs = dict(decl.get("jit_kwargs", {}))
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn, **jit_kwargs)
+
+    donated_leaves = 0
+    donate = jit_kwargs.get("donate_argnums", ())
+    if isinstance(donate, int):
+        donate = (donate,)
+    # jit flattens positional args in order, so donated parameter indices
+    # are exactly the flattened-leaf ranges of the donated argnums — and the
+    # shipped steps donate argnum 0, making the range a prefix.
+    offset = 0
+    donated_idx: set[int] = set()
+    for i, a in enumerate(args):
+        n = len(jax.tree_util.tree_leaves(a))
+        if i in donate:
+            donated_idx.update(range(offset, offset + n))
+        offset += n
+    if donated_idx:
+        if donated_idx != set(range(len(donated_idx))):
+            raise ValueError(
+                f"{HLO_HOOK} in {path}: donated argnums must form a leading "
+                f"prefix of the flattened arguments (got {sorted(donated_idx)})"
+            )
+        donated_leaves = len(donated_idx)
+
+    code = getattr(fn, "__code__", None) or getattr(
+        getattr(fn, "__wrapped__", None), "__code__", None
+    )
+    line = code.co_firstlineno if code else 1
+    text, _, donation_warns = lower_and_compile(jitted, args)
+    findings, _ = analyze_module(
+        text,
+        label=f"{HLO_HOOK} in {os.path.basename(path)}",
+        where=(path, line),
+        world=_usable_world(world),
+        donated_leaves=donated_leaves,
+        metric_reductions=int(decl.get("metric_reductions", 0)),
+        expect_grad_reduce=bool(decl.get("expect_grad_reduce", False)),
+        expect_fingerprint=decl.get("expect_fingerprint"),
+        donation_warnings=donation_warns,
+    )
+    return findings

@@ -1,0 +1,194 @@
+"""RecompileGuard: retrace hazards, statically (DP305) and at run time.
+
+A jitted step that silently recompiles turns a 10 ms step into a
+multi-second one with no error anywhere — the classic step-time cliff
+("Scalable Training of Language Models using JAX pjit and TPUv4",
+arXiv:2204.06514, attributes exactly this to unintended retracing). Two
+halves:
+
+- **DP305 (static)**: `jax.jit` applied to a fresh lambda inside a function
+  body, or any `jax.jit(...)` call lexically inside a loop. Both build a new
+  wrapper object per call/iteration, so the trace cache the old wrapper
+  accumulated is garbage — every invocation pays a full retrace+compile.
+  The factory idiom (`make_train_step` returning `jax.jit(step, ...)` once)
+  is specifically *not* flagged: jitting a named nested function outside a
+  loop is how every shipped factory works.
+- **Runtime (`RecompileGuard`)**: wraps a jitted callable, snapshots its
+  trace-cache size after warmup, and counts any post-warmup growth as a
+  retrace — warning (or raising) with the count instead of letting a pod
+  silently fall off the compile cliff. `train/trainer.py` wraps the train
+  step programs with it (``train.recompile_guard`` config: warn|raise|off;
+  skipped without ``drop_remainder``, where the final partial batch
+  legitimately compiles a second variant every epoch). `bench.py`'s
+  compile-stats block (lowering/compile times + collective histogram)
+  comes from the sibling Level-3 classifier in `tpu_dp.analysis.hlo`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Callable
+
+from tpu_dp.analysis import pragmas
+from tpu_dp.analysis.astlint import _dotted, scope_index, scope_at
+from tpu_dp.analysis.report import Finding
+
+_JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit"}
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    dotted = _dotted(call.func)
+    return dotted in _JIT_NAMES
+
+
+class _Dp305Linter:
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.allowed = pragmas.collect(source)
+        self.findings: list[Finding] = []
+
+    def _emit(self, line: int, message: str, symbol: str) -> None:
+        if pragmas.is_allowed(self.allowed, "DP305", (line,)):
+            return
+        self.findings.append(
+            Finding("DP305", self.path, line, message, symbol=symbol)
+        )
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.source, filename=self.path)
+        except SyntaxError:
+            return []  # astlint reports the parse failure
+        scopes = scope_index(tree)
+
+        # (a) jax.jit called lexically inside a loop: a fresh wrapper —
+        # and a fresh, empty trace cache — every iteration.
+        in_loop: set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                for inner in ast.walk(node):
+                    in_loop.add(id(inner))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_jit_call(node)):
+                continue
+            symbol = scope_at(scopes, node.lineno)
+            if id(node) in in_loop:
+                self._emit(
+                    node.lineno,
+                    "jax.jit called inside a loop — every iteration builds "
+                    "a fresh wrapper with an empty trace cache, so every "
+                    "call retraces and recompiles; hoist the jit out of "
+                    "the loop",
+                    symbol,
+                )
+            elif symbol and any(
+                isinstance(arg, ast.Lambda) for arg in node.args
+            ):
+                # (b) jit(lambda ...) inside a function: each call of the
+                # enclosing function makes a new closure whose cache dies
+                # with it. Module-scope jit(lambda) is a one-time cost.
+                self._emit(
+                    node.lineno,
+                    "jax.jit of a fresh lambda inside a function — each "
+                    "call of the enclosing function builds a new callable "
+                    "with its own empty trace cache; define the jitted "
+                    "function once (module scope or a cached factory)",
+                    symbol,
+                )
+        return self.findings
+
+
+def lint_source(path: str, source: str) -> list[Finding]:
+    """The DP305 static pass over one file (pure AST; no jax import)."""
+    return sorted(_Dp305Linter(path, source).run(),
+                  key=lambda f: f.line)
+
+
+class RecompileError(RuntimeError):
+    """A guarded step retraced after warmup with on_retrace='raise'."""
+
+
+class RecompileGuard:
+    """Wrap a jitted callable; count retraces after warmup; warn or raise.
+
+    The trace-cache size (`PjitFunction._cache_size`) is the retrace
+    observable: any growth after the warmup calls means an argument's
+    abstract signature changed — a Python scalar where an array belongs, a
+    weak-type flip, a new batch shape — and XLA just recompiled the whole
+    step behind the caller's back.
+
+    ``warmup_calls`` calls establish the baseline (1 for a fixed-shape train
+    step; more when the first window legitimately compiles variants).
+    ``on_retrace``: "warn" logs through ``logger`` (default: stderr),
+    "raise" raises `RecompileError` — CI's choice.
+    """
+
+    def __init__(
+        self,
+        fn: Callable,
+        name: str | None = None,
+        warmup_calls: int = 1,
+        on_retrace: str = "warn",
+        logger: Callable[[str], None] | None = None,
+    ):
+        if on_retrace not in ("warn", "raise"):
+            raise ValueError(
+                f"on_retrace must be warn|raise, got {on_retrace!r}"
+            )
+        self._fn = fn
+        self.name = name or getattr(fn, "__name__", "jitted")
+        self.warmup_calls = max(1, int(warmup_calls))
+        self.on_retrace = on_retrace
+        self._log = logger
+        self.calls = 0
+        self.retraces = 0
+        self._baseline: int | None = None
+
+    def _cache_size(self) -> int | None:
+        probe = getattr(self._fn, "_cache_size", None)
+        try:
+            return int(probe()) if callable(probe) else None
+        except Exception:
+            return None
+
+    def __call__(self, *args, **kwargs) -> Any:
+        out = self._fn(*args, **kwargs)
+        self.calls += 1
+        size = self._cache_size()
+        if size is None:
+            return out
+        if self.calls <= self.warmup_calls or self._baseline is None:
+            self._baseline = max(self._baseline or 0, size)
+        elif size > self._baseline:
+            grew = size - self._baseline
+            self._baseline = size
+            self.retraces += grew
+            msg = (
+                f"RecompileGuard({self.name}): {grew} retrace(s) after "
+                f"warmup (call {self.calls}, trace cache now {size}) — an "
+                f"argument's shape/dtype/weak-type changed across calls; "
+                f"the step recompiled instead of hitting the cache"
+            )
+            if self.on_retrace == "raise":
+                raise RecompileError(msg)
+            if self._log is not None:
+                self._log(msg)
+            else:
+                import sys
+
+                print(msg, file=sys.stderr)
+        return out
+
+    def stats(self) -> dict:
+        """BENCH/report block: calls, retraces, final cache size."""
+        return {
+            "name": self.name,
+            "calls": self.calls,
+            "retraces": self.retraces,
+            "cache_size": self._cache_size(),
+        }
+
+    def __getattr__(self, item):
+        # Transparent proxy for jit-object introspection (lower, etc.).
+        return getattr(self._fn, item)

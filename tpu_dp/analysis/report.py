@@ -1,35 +1,104 @@
 """dplint findings: the shared record every rule emits and the CLI prints.
 
 One `Finding` per violation, attributed to a file:line so editors and CI can
-jump to it. Rule metadata lives in `RULES` — `docs/ANALYSIS.md` is the prose
-version, this table is what `--list-rules` prints and what tests assert
-against.
+jump to it, plus a ``symbol`` (enclosing function/class, or the analyzed
+program's name) so a finding has a *stable* identity across unrelated edits:
+`fingerprint()` is rule+path+symbol, never a line number, and is what
+`--baseline` suppression keys on. Rule metadata lives in `RULES` —
+`docs/ANALYSIS.md` is the prose version, this table is what `--list-rules`
+prints and what tests assert against.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import os
 
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
     """One rule violation at a source location."""
 
-    rule: str  # "DP101" ... "DP204"
+    rule: str  # "DP101" ... "DP305"
     path: str  # file the finding is attributed to
     line: int  # 1-based line number
     message: str
+    symbol: str = ""  # enclosing def/class qualname, or the program label
 
     def format(self) -> str:
         return f"{self.path}:{self.line}: {self.rule} {self.message}"
 
     def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = fingerprint(self)
+        return d
+
+
+def fingerprint(f: Finding, root: str | None = None) -> str:
+    """Stable finding identity for baseline suppression: rule+path+symbol.
+
+    Line numbers are deliberately absent — a baseline must survive unrelated
+    edits shifting the file. The path is repo-root-relative (posix
+    separators) when the finding sits under ``root`` (default: the repo this
+    package lives in), so the same baseline works from any checkout
+    location.
+    """
+    if root is None:
+        root = _repo_root()
+    path = os.path.abspath(f.path)
+    root = os.path.abspath(root)
+    if path.startswith(root + os.sep):
+        path = os.path.relpath(path, root)
+    # Out-of-repo files keep their absolute path: collapsing to a basename
+    # would alias same-named files in different directories, letting one
+    # baselined file's entry suppress another file's distinct finding.
+    return f"{f.rule}:{path.replace(os.sep, '/')}:{f.symbol}"
+
+
+def _repo_root() -> str:
+    # tpu_dp/analysis/report.py -> repo root two levels above the package.
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+
+
+def load_baseline(path: str) -> set[str]:
+    """The suppressed-fingerprint set a `--baseline` file declares.
+
+    Accepts either the native layout ``{"suppress": [fp, ...]}`` (what
+    `--write-baseline` emits) or a bare JSON list of fingerprints.
+    """
+    with open(path, encoding="utf-8") as f:
+        payload = json.load(f)
+    if isinstance(payload, list):
+        return set(payload)
+    if isinstance(payload, dict) and isinstance(payload.get("suppress"), list):
+        return set(payload["suppress"])
+    raise ValueError(
+        f"baseline {path!r}: expected a JSON list of fingerprints or "
+        f'{{"suppress": [...]}}'
+    )
+
+
+def write_baseline(path: str, findings: list[Finding]) -> int:
+    """Write the current findings as a baseline; returns the entry count."""
+    fps = sorted({fingerprint(f) for f in findings})
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "suppress": fps}, f, indent=2)
+        f.write("\n")
+    return len(fps)
+
+
+def apply_baseline(
+    findings: list[Finding], suppressed: set[str]
+) -> list[Finding]:
+    return [f for f in findings if fingerprint(f) not in suppressed]
 
 
 # rule id -> (title, one-line failure mode). Level 1 (DP1xx) is the AST
-# lint; level 2 (DP2xx) is the jaxpr/semantic pass.
+# lint; level 2 (DP2xx) is the jaxpr/semantic pass; level 3 (DP3xx)
+# verifies the compiled XLA artifact (tpu_dp.analysis.hlo / recompile).
 RULES: dict[str, tuple[str, str]] = {
     "DP101": (
         "collective or rank-divergent work under a rank gate",
@@ -71,6 +140,36 @@ RULES: dict[str, tuple[str, str]] = {
         "an argument passed to a donate_argnums step is dead afterwards; "
         "reading it returns garbage or raises on real backends",
     ),
+    "DP301": (
+        "unintended cross-replica communication in the compiled program",
+        "an all-gather/reduce-scatter/permute, a second replica grouping, "
+        "or extra scalar reductions in the HLO betray a bad PartitionSpec "
+        "— the DP step must compile to one combinable gradient all-reduce "
+        "group plus the declared metric reductions",
+    ),
+    "DP302": (
+        "host transfer inside the compiled hot loop",
+        "an infeed/outfeed/send/recv or host-callback custom-call in the "
+        "step module stalls every step on the host round-trip",
+    ),
+    "DP303": (
+        "buffer donation silently dropped by XLA",
+        "a donate_argnums buffer missing from the compiled module's "
+        "input_output_alias doubles parameter memory — XLA drops aliasing "
+        "with only a warning",
+    ),
+    "DP304": (
+        "collective schedule diverges from the pinned fingerprint",
+        "ranks running binaries with different compiled collective "
+        "sequences deadlock mid-step; the fingerprint comparison fails "
+        "fast at startup instead",
+    ),
+    "DP305": (
+        "retrace hazard at the jit boundary",
+        "jax.jit of a fresh lambda/closure or inside a loop recompiles "
+        "every call — the compile-cache key never hits and step time "
+        "cliffs silently",
+    ),
 }
 
 
@@ -78,21 +177,30 @@ def sort_findings(findings: list[Finding]) -> list[Finding]:
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 
-def render_text(findings: list[Finding]) -> str:
+def render_text(findings: list[Finding], error: str | None = None) -> str:
     lines = [f.format() for f in sort_findings(findings)]
-    lines.append(
-        f"dplint: {len(findings)} finding(s)" if findings
-        else "dplint: clean"
-    )
+    if error is not None:
+        lines.append(f"dplint: internal error after {len(findings)} "
+                     f"finding(s) (partial results above): {error}")
+    else:
+        lines.append(
+            f"dplint: {len(findings)} finding(s)" if findings
+            else "dplint: clean"
+        )
     return "\n".join(lines)
 
 
-def render_json(findings: list[Finding]) -> str:
-    return json.dumps(
-        {"findings": [f.to_dict() for f in sort_findings(findings)],
-         "count": len(findings)},
-        indent=2,
-    )
+def render_json(findings: list[Finding], error: str | None = None) -> str:
+    payload: dict = {
+        "findings": [f.to_dict() for f in sort_findings(findings)],
+        "count": len(findings),
+    }
+    if error is not None:
+        # Partial results: the findings collected before the internal error.
+        # The traceback goes to stderr; stdout stays machine-parseable.
+        payload["internal_error"] = error
+        payload["partial"] = True
+    return json.dumps(payload, indent=2)
 
 
 def list_rules() -> str:

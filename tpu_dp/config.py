@@ -88,6 +88,15 @@ class TrainConfig:
     resume: bool = False
     profile_dir: str | None = None  # enable jax.profiler traces when set
     pallas_xent: bool = False  # fused Pallas softmax-xent kernel (TPU)
+    # RecompileGuard (tpu_dp/analysis/recompile.py): count retraces of the
+    # compiled train-step programs after warmup — a silent recompile is a
+    # step-time cliff. "warn" logs, "raise" aborts (CI), "off" disables.
+    recompile_guard: str = "warn"
+    # Cross-rank collective-schedule fingerprint check at startup (dplint
+    # DP304): every rank digests the compiled train step's collective
+    # sequence and compares against rank 0 — desynced binaries fail fast
+    # instead of deadlocking mid-step. Costs one AOT compile; off by default.
+    verify_fingerprint: bool = False
 
 
 @dataclass

@@ -223,6 +223,47 @@ def fault_tolerant_barrier(mesh: Mesh | None = None, retries: int = 2,
         ) from e
 
 
+def verify_collective_fingerprint(digest: str, tag: str = "train_step") -> str:
+    """Fail fast when ranks are about to run different collective schedules.
+
+    ``digest`` is the collective-schedule fingerprint of the program this
+    process is about to execute (`tpu_dp.analysis.hlo.program_fingerprint`
+    — a sha256 over the ordered collective sequence + replica groups of the
+    compiled module; `artifacts/collective_fingerprint.json` is the lint-time
+    record of the same digests). Rank 0's digest is broadcast and every rank
+    compares: a desynced binary — a rank running a stale build, a different
+    JAX version, a diverged config — raises here, at startup, instead of
+    deadlocking the whole slice mid-step when its collective sequence first
+    disagrees. Single-process runs return the digest unchecked.
+
+    The startup half of dplint rule DP304 (`docs/ANALYSIS.md`).
+    """
+    if len(digest) != 64 or any(c not in "0123456789abcdef" for c in digest):
+        raise ValueError(f"not a sha256 hex digest: {digest!r}")
+    if jax.process_count() == 1:
+        return digest
+    from jax.experimental import multihost_utils
+
+    # Allgather, not broadcast: EVERY rank must see the mismatch and raise.
+    # (With a rank-0 broadcast, only the divergent rank would die — rank 0
+    # would sail past the check and hang at its first collective waiting
+    # for the dead peer, the exact deadlock this hook exists to prevent.)
+    mine = np.frombuffer(bytes.fromhex(digest), dtype=np.uint8).copy()
+    gathered = np.asarray(multihost_utils.process_allgather(mine))
+    bad = [r for r in range(gathered.shape[0])
+           if not np.array_equal(gathered[r], gathered[0])]
+    if bad:
+        raise RuntimeError(
+            f"collective-schedule fingerprint mismatch ({tag}): process "
+            f"{jax.process_index()}/{jax.process_count()} compiles "
+            f"{digest[:16]}…, rank 0 compiles "
+            f"{bytes(gathered[0]).hex()[:16]}… (divergent ranks: {bad}) — "
+            f"ranks are running different binaries/configs and would "
+            f"deadlock at the first divergent collective; refusing to start"
+        )
+    return digest
+
+
 def describe(mesh: Mesh | None = None) -> dict:
     """Topology summary for startup logs and diagnostics.
 
