@@ -208,27 +208,51 @@ print("PROBE_OK", jax.default_backend(), len(jax.devices()), d.device_kind, sep=
 """
 
 
+def probe_schedule(attempts: int, timeout_s: float, retry_wait_s: float,
+                   timeout_cap_s: float = 360.0, wait_cap_s: float = 120.0,
+                   growth: float = 2.0) -> list[tuple[float, float]]:
+    """(wait_before_s, timeout_s) per probe attempt — exponential backoff.
+
+    The old fixed 3×75s schedule gave up inside a relay outage's typical
+    recovery window, so every BENCH during an outage went out `stale`
+    (BENCH_r01–r05). Backoff holds the total budget similar at the front
+    (fail fast when the device is truly absent) while the later attempts
+    wait long enough for a recovering relay to come back: both the
+    inter-attempt wait and the per-attempt timeout double, capped.
+    """
+    return [
+        (0.0 if i == 0 else min(retry_wait_s * growth ** (i - 1), wait_cap_s),
+         min(timeout_s * growth ** i, timeout_cap_s))
+        for i in range(attempts)
+    ]
+
+
 def probe_device(attempts: int, timeout_s: float, retry_wait_s: float,
                  env: dict | None = None):
-    """(info dict | None, failure string). Tiny matmul in a subprocess."""
+    """(info dict | None, failure string). Tiny matmul in a subprocess,
+    retried on an exponential-backoff schedule (`probe_schedule`)."""
     failure = "unknown"
-    for i in range(attempts):
-        if i:
-            time.sleep(retry_wait_s)
+    schedule = probe_schedule(attempts, timeout_s, retry_wait_s)
+    for i, (wait_s, t_s) in enumerate(schedule):
+        if wait_s:
+            time.sleep(wait_s)
         rc, out, err = _run_sub(
-            [sys.executable, "-c", PROBE_SRC], timeout_s, env=env)
+            [sys.executable, "-c", PROBE_SRC], t_s, env=env)
         for line in out.splitlines():
             if line.startswith("PROBE_OK"):
                 _, backend, n, kind = line.split("\t")
                 return {"backend": backend, "n_devices": int(n),
                         "device_kind": kind}, ""
         if rc == 124:
-            failure = f"probe timeout after {timeout_s:.0f}s (relay wedged?)"
+            failure = f"probe timeout after {t_s:.0f}s (relay wedged?)"
         else:
             tail = (err.strip().splitlines() or ["no stderr"])[-1]
             failure = f"probe rc={rc}: {tail[:300]}"
-        print(f"bench: device probe {i + 1}/{attempts} failed: {failure}",
-              file=sys.stderr)
+        nxt = (f"; retrying in {schedule[i + 1][0]:.0f}s with "
+               f"{schedule[i + 1][1]:.0f}s timeout"
+               if i + 1 < len(schedule) else "")
+        print(f"bench: device probe {i + 1}/{attempts} failed: "
+              f"{failure}{nxt}", file=sys.stderr)
     return None, failure
 
 
@@ -273,6 +297,21 @@ def compile_with_flops(jitted, *eg_args):
     return compiled, flops, stats
 
 
+def _make_step(model, opt, mesh, sched, use_pallas, update_sharding):
+    """The production per-step program for the requested update mode:
+    GSPMD (`make_train_step`) for replicated, explicit-collectives
+    `make_train_step_shard_map` for the sharded weight update."""
+    from tpu_dp.train import make_train_step, make_train_step_shard_map
+
+    if update_sharding == "sharded":
+        return make_train_step_shard_map(
+            model, opt, mesh, sched, use_pallas_xent=use_pallas,
+            update_sharding=update_sharding,
+        )
+    return make_train_step(model, opt, mesh, sched,
+                           use_pallas_xent=use_pallas)
+
+
 def measure_point(cfg: dict) -> dict:
     """Measure one (batch/chip, xent impl, window) point; return a record.
 
@@ -293,7 +332,7 @@ def measure_point(cfg: dict) -> dict:
         batch_sharding, scan_batch_sharding, shard_batch,
     )
     from tpu_dp.train import (
-        SGD, cosine_lr, create_train_state, make_multi_step, make_train_step,
+        SGD, cosine_lr, create_train_state, make_multi_step,
     )
 
     per_chip = int(cfg["per_chip_batch"])
@@ -301,6 +340,7 @@ def measure_point(cfg: dict) -> dict:
     measure_steps = int(cfg["measure_steps"])
     use_pallas = bool(cfg["pallas_xent"])
     fused_stages = str(cfg.get("fused_stages", "") or "")
+    update_sharding = str(cfg.get("update_sharding", "replicated"))
     model_name = cfg.get("model", "resnet18")
     flops_per_image, num_classes = MODEL_SPECS[model_name]
     metric = metric_for(model_name, num_classes)
@@ -317,6 +357,12 @@ def measure_point(cfg: dict) -> dict:
                         fused_block_b=int(cfg.get("fused_block_b", 0)),
                         fused_bwd=bool(cfg.get("fused_bwd", False)))
     opt = SGD(momentum=0.9, weight_decay=5e-4)
+    if update_sharding == "sharded":
+        # Cross-replica sharded weight update (docs/PERF.md): reduce-scatter
+        # grads, step 1/n_chips of params+momentum per chip, all-gather.
+        from tpu_dp.train import shard_optimizer
+
+        opt = shard_optimizer(opt, n_chips)
     state = create_train_state(
         model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32), opt
     )
@@ -335,7 +381,8 @@ def measure_point(cfg: dict) -> dict:
     # device->host value transfer is an honest fence.
     if window > 1:
         loop = make_multi_step(model, opt, mesh, sched, num_steps=window,
-                               use_pallas_xent=use_pallas)
+                               use_pallas_xent=use_pallas,
+                               update_sharding=update_sharding)
         stacked = {
             "image": np.stack([d.images for d in host_pool]),
             "label": np.stack([d.labels for d in host_pool]),
@@ -353,8 +400,8 @@ def measure_point(cfg: dict) -> dict:
         n_steps_timed = window
         step_flops = None  # resolved below, after the provisional record
     else:
-        step = make_train_step(model, opt, mesh, sched,
-                               use_pallas_xent=use_pallas)
+        step = _make_step(model, opt, mesh, sched, use_pallas,
+                          update_sharding)
         batches = [
             shard_batch({"image": d.images, "label": d.labels}, mesh,
                         spec=batch_sharding(mesh))
@@ -459,6 +506,7 @@ def measure_point(cfg: dict) -> dict:
                 "xent": "pallas" if use_pallas else "jnp",
                 "fused_stages": fused_stages,
                 "fused_bwd": bool(cfg.get("fused_bwd", False)),
+                "update_sharding": update_sharding,
             },
         }
         if snapshot_rec is not None:
@@ -476,8 +524,8 @@ def measure_point(cfg: dict) -> dict:
         emit(build(*resolve_flops_per_step(
             program_flops, None, window, per_chip, flops_per_image)))
         try:
-            step = make_train_step(model, opt, mesh, sched,
-                                   use_pallas_xent=use_pallas)
+            step = _make_step(model, opt, mesh, sched, use_pallas,
+                              update_sharding)
             single = shard_batch(
                 {"image": host_pool[0].images, "label": host_pool[0].labels},
                 mesh, spec=batch_sharding(mesh))
@@ -595,13 +643,26 @@ def main() -> None:
                          "path; also the schedule horizon")
     ap.add_argument("--steps-per-call", type=int, default=30,
                     help="scan-window length of the headline point")
+    ap.add_argument("--update-sharding", default="replicated",
+                    choices=["replicated", "sharded"],
+                    help="weight-update mode (train.update_sharding): "
+                         "'sharded' reduce-scatters grads, updates 1/N of "
+                         "params+momentum per chip, all-gathers updated "
+                         "params (docs/PERF.md); recorded in the BENCH "
+                         "json config block")
     ap.add_argument("--snapshot-every", type=int, default=0,
                     help="also measure async-snapshot overhead at this step "
                          "cadence (tpu_dp.resilience.SnapshotManager; the "
                          "record gains a 'snapshot' block with overhead_pct)")
-    ap.add_argument("--probe-timeout", type=float, default=75.0)
-    ap.add_argument("--probe-attempts", type=int, default=3)
-    ap.add_argument("--probe-retry-wait", type=float, default=15.0)
+    ap.add_argument("--probe-timeout", type=float, default=45.0,
+                    help="FIRST probe attempt's timeout (seconds); later "
+                         "attempts double it, capped at 360s — exponential "
+                         "backoff so a recovering relay is retried past "
+                         "its outage window instead of the old rigid 3x75s")
+    ap.add_argument("--probe-attempts", type=int, default=4)
+    ap.add_argument("--probe-retry-wait", type=float, default=10.0,
+                    help="wait before the second probe attempt; doubles "
+                         "per retry, capped at 120s")
     ap.add_argument("--point-timeout", type=float, default=900.0)
     ap.add_argument("--_measure", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -646,7 +707,8 @@ def main() -> None:
     base = {"measure_steps": args.measure_steps, "platform": args.platform,
             "model": args.model, "fused_stages": args.fused_stages,
             "fused_block_b": args.fused_block_b, "fused_bwd": args.fused_bwd,
-            "snapshot_every": args.snapshot_every}
+            "snapshot_every": args.snapshot_every,
+            "update_sharding": args.update_sharding}
     if args.sweep:
         grid = [
             dict(base, per_chip_batch=b, pallas_xent=px, steps_per_call=w)
@@ -683,7 +745,9 @@ def main() -> None:
                f"w{cfg['steps_per_call']}"
                + (f"/fused[{cfg['fused_stages']}"
                   f"{'+bwd' if cfg.get('fused_bwd') else ''}]"
-                  if cfg.get("fused_stages") else ""))
+                  if cfg.get("fused_stages") else "")
+               + ("/sharded-update"
+                  if cfg.get("update_sharding") == "sharded" else ""))
         got = (f"{rec['value']} {UNIT}, mfu={rec.get('mfu')}"
                if rec.get("value") else rec.get("error"))
         print(f"bench: [{i + 1}/{len(grid)}] {tag}: {got}", file=sys.stderr)

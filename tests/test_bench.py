@@ -254,3 +254,38 @@ def test_fused_sweep_grid_covers_both_windows(monkeypatch, capsys):
                 ("0", True), ("all", True)}
     assert pts == {(fs, fb, w) for fs, fb in variants for w in (1, 30)}
     assert len(grids) == 10
+
+
+def test_probe_schedule_exponential_backoff():
+    """The probe schedule doubles both the inter-attempt wait and the
+    per-attempt timeout, capped — the fix for the BENCH_r01–r05 staleness
+    (a rigid 3x75s probe gave up before the relay recovered)."""
+    sched = bench.probe_schedule(4, 45.0, 10.0)
+    assert sched == [(0.0, 45.0), (10.0, 90.0), (20.0, 180.0), (40.0, 360.0)]
+    # Caps hold on long schedules.
+    long = bench.probe_schedule(8, 45.0, 10.0)
+    assert max(t for _, t in long) == 360.0
+    assert max(w for w, _ in long) == 120.0
+    # A single attempt probes immediately at the base timeout.
+    assert bench.probe_schedule(1, 75.0, 15.0) == [(0.0, 75.0)]
+
+
+def test_update_sharding_recorded_in_grid(monkeypatch, capsys):
+    """--update-sharding flows into every grid point's config (and from
+    there into the BENCH json config block via measure_point)."""
+    grids = []
+
+    def fake_run_point(cfg, timeout_s):
+        grids.append(cfg)
+        return {"value": 1.0, "unit": bench.UNIT, "vs_baseline": 0.0,
+                "metric": bench.METRIC, "config": cfg}
+
+    monkeypatch.setattr(bench, "probe_device", lambda *a, **k: (
+        {"n_devices": 1, "device_kind": "x", "backend": "tpu"}, None))
+    monkeypatch.setattr(bench, "run_point", fake_run_point)
+    monkeypatch.setattr(bench, "archive", lambda r: None)
+    monkeypatch.setattr(bench.sys, "argv",
+                        ["bench.py", "--update-sharding", "sharded"])
+    bench.main()
+    capsys.readouterr()
+    assert grids and all(g["update_sharding"] == "sharded" for g in grids)

@@ -66,17 +66,150 @@ def test_shipped_train_steps_are_proven_aliased(repo_hlo):
 
 
 def test_shipped_steps_have_one_combinable_gradient_group(repo_hlo):
-    """The train-step modules contain only all-reduces: a single combinable
-    gradient group (full-mesh replica groups, add) plus the two metric
-    scalars — no all-gather/reduce-scatter/permute anywhere."""
+    """Replicated-mode train-step modules contain only all-reduces: a
+    single combinable gradient group (full-mesh replica groups, add) plus
+    the two metric scalars — no all-gather/reduce-scatter/permute
+    anywhere."""
     _, artifact = repo_hlo
+    checked = 0
     for name, rec in artifact["programs"].items():
+        if rec["update_sharding"] != "replicated":
+            continue
+        checked += 1
         assert set(rec["counts"]) <= {"all-reduce"}, (name, rec["counts"])
         groups = {op["replica_groups"] for op in rec["collectives"]}
         assert len(groups) <= 1, (name, groups)
         if name != "eval_step":
-            assert rec["grad_allreduce_ops"] >= 1, name
+            assert rec["grad_reduce_ops"] >= 1, name
         assert rec["metric_allreduce_ops"] == 2, (name, rec)
+    assert checked >= 3
+
+
+def test_shipped_sharded_steps_have_scatter_update_gather_schedule(repo_hlo):
+    """Sharded-mode train-step modules compile to the second legal
+    schedule: one combinable reduce-scatter group + one all-gather group
+    over the identical full-mesh replica groups, the two metric scalars,
+    and NO non-scalar all-reduce (the gradient path really went through
+    the scatter)."""
+    _, artifact = repo_hlo
+    sharded = {k: v for k, v in artifact["programs"].items()
+               if v["update_sharding"] == "sharded"}
+    assert sharded, "no sharded programs in the shipped artifact"
+    for name, rec in sharded.items():
+        counts = rec["counts"]
+        assert set(counts) == {"reduce-scatter", "all-gather", "all-reduce"}, (
+            name, counts)
+        by_kind = {}
+        for op in rec["collectives"]:
+            by_kind.setdefault(op["kind"], []).append(op)
+        # One combinable group per collective kind, scatter == gather.
+        scatter_groups = {op["replica_groups"]
+                          for op in by_kind["reduce-scatter"]}
+        gather_groups = {op["replica_groups"] for op in by_kind["all-gather"]}
+        assert len(scatter_groups) == 1 and scatter_groups == gather_groups, (
+            name, scatter_groups, gather_groups)
+        assert all(op["reduction"] == "add"
+                   for op in by_kind["reduce-scatter"]), name
+        # Every all-reduce left is a declared metric scalar.
+        assert len(by_kind["all-reduce"]) == rec["metric_allreduce_ops"] == 2
+        assert rec["grad_reduce_ops"] == len(by_kind["reduce-scatter"]) >= 1
+        # Donation survives the sharded layout: opt-state shards alias too.
+        assert rec["aliased_inputs"] == rec["donated_inputs"] > 0, name
+
+
+def test_fingerprint_distinguishes_update_sharding_modes(repo_hlo):
+    """The collective-schedule digest separates the two legal schedules:
+    the sharded step cannot impersonate the replicated one (DP304's
+    cross-rank check would catch a mode-diverged rank)."""
+    _, artifact = repo_hlo
+    progs = artifact["programs"]
+    d_repl = progs["train_step[shard_map]@accum1"]["digest"]
+    d_shard = progs["train_step[shard_map,sharded]@accum1"]["digest"]
+    assert d_repl != d_shard
+    assert progs["train_step[shard_map,sharded]@accum1"][
+        "update_sharding"] == "sharded"
+
+
+def test_dp301_fires_on_mismatched_scatter_gather_axes():
+    """A sharded-update program whose reduce-scatter and all-gather run
+    over different axes (the dp306 fixture's bug) — and one whose gradient
+    bypassed the scatter into a plain all-reduce — both fail DP301's
+    sharded-mode classification."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tpu_dp.train.step import _shard_map
+
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh2d = Mesh(devices, ("data", "model"))
+
+    def bad_axes(g):
+        shard = jax.lax.psum_scatter(g, "model", scatter_dimension=0,
+                                     tiled=True)
+        return jax.lax.all_gather(shard - 0.1 * shard, "data", axis=0,
+                                  tiled=True)[: g.size]
+
+    fn = jax.jit(_shard_map(bad_axes, mesh2d, (P(),), P()))
+    text, _, _ = hlo.lower_and_compile(fn, (jnp.zeros((32,), jnp.float32),))
+    findings, _ = hlo.analyze_module(
+        text, label="bad", where=("x.py", 1), world=8,
+        update_sharding="sharded", expect_grad_reduce=True,
+    )
+    assert any("do not match all-gather replica groups" in f.message
+               for f in findings), findings
+    assert all(f.rule == "DP301" for f in findings)
+
+    # Gradient bypassing the scatter: a non-scalar all-reduce in sharded
+    # mode is its own DP301.
+    from tpu_dp.parallel import collectives as coll
+    from tpu_dp.parallel import dist
+
+    mesh1d = dist.data_mesh()
+
+    def bypass(g):
+        return coll.pmean(g, dist.DATA_AXIS)
+
+    fn2 = jax.jit(_shard_map(bypass, mesh1d, (P(dist.DATA_AXIS),), P()))
+    text2, _, _ = hlo.lower_and_compile(fn2, (jnp.zeros((16, 4),
+                                                        jnp.float32),))
+    findings2, _ = hlo.analyze_module(
+        text2, label="bypass", where=("x.py", 1), world=8,
+        update_sharding="sharded",
+    )
+    assert any("bypassed the reduce-scatter" in f.message
+               for f in findings2), findings2
+
+
+def test_dp301_accepts_legal_sharded_schedule_unit():
+    """The minimal legal sharded schedule (scatter → update → gather over
+    one axis) passes sharded-mode DP301 — and fails replicated-mode DP301
+    (the schedule split really keys off the declared mode)."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dp.parallel import dist
+    from tpu_dp.train.step import _shard_map
+
+    mesh = dist.data_mesh()
+
+    def good(g):
+        flat = jnp.pad(g.reshape(-1), (0, (-g.size) % 8))
+        shard = jax.lax.psum_scatter(flat, dist.DATA_AXIS,
+                                     scatter_dimension=0, tiled=True) / 8.0
+        new = shard - 0.1 * shard
+        full = jax.lax.all_gather(new, dist.DATA_AXIS, axis=0, tiled=True)
+        return full[: g.size].reshape(g.shape)
+
+    fn = jax.jit(_shard_map(good, mesh, (P(),), P()))
+    text, _, _ = hlo.lower_and_compile(fn, (jnp.zeros((30,), jnp.float32),))
+    ok, _ = hlo.analyze_module(text, label="good", where=("x.py", 1),
+                               world=8, update_sharding="sharded",
+                               expect_grad_reduce=True)
+    assert ok == []
+    bad, _ = hlo.analyze_module(text, label="good-as-repl",
+                                where=("x.py", 1), world=8,
+                                update_sharding="replicated",
+                                expect_grad_reduce=True)
+    assert bad, "replicated-mode DP301 accepted a scatter/gather schedule"
 
 
 def test_artifact_records_compile_stats(repo_hlo):

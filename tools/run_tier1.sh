@@ -4,6 +4,11 @@
 #
 #   tools/run_tier1.sh               # full tier-1 suite (CPU backend)
 #   tools/run_tier1.sh --resilience  # fast lane: only -m resilience tests
+#   tools/run_tier1.sh --shard-update # parity lane: the sharded weight-
+#                                    # update suite (-m shard_update) — the
+#                                    # sharded-vs-replicated bitwise
+#                                    # property, checkpoint resharding, and
+#                                    # the sharded kill+resume contract
 #   tools/run_tier1.sh --dplint      # static-analysis lane: all three
 #                                    # dplint levels (AST + jaxpr + compiled
 #                                    # HLO) over tpu_dp/ + the -m analysis
@@ -21,6 +26,11 @@ LOG=${TIER1_LOG:-/tmp/_t1.log}
 
 if [ "${1:-}" = "--resilience" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m resilience \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--shard-update" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m shard_update \
         -p no:cacheprovider
 fi
 

@@ -213,11 +213,17 @@ def main(argv: list[str] | None = None) -> int:
             if has_repo_step:
                 from tpu_dp.analysis import gradsync
 
+                # Both legal update schedules: the replicated gradient
+                # pmean and the sharded reduce-scatter path
+                # (train.update_sharding) each carry the exactly-one-
+                # reduction-per-leaf contract.
                 for accum in accum_variants:
-                    got, _ = gradsync.verify_repo_step(
-                        accum_steps=accum, world=args.world
-                    )
-                    findings.extend(got)
+                    for mode in ("replicated", "sharded"):
+                        got, _ = gradsync.verify_repo_step(
+                            accum_steps=accum, world=args.world,
+                            update_sharding=mode,
+                        )
+                        findings.extend(got)
             for f in files:
                 if _STEP_HOOK in hooks[f]:
                     findings.extend(

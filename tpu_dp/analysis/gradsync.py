@@ -34,7 +34,12 @@ from tpu_dp.analysis.report import Finding
 # Primitives that reduce over a named mesh axis. `lax.pmean` traces as
 # psum-then-div, so psum covers both; pmin/pmax are not gradient
 # reductions but still cross-replica syncs worth counting on a grad path.
-_REDUCTION_PRIMS = {"psum", "pmin", "pmax", "psum2"}
+# `reduce_scatter` (lax.psum_scatter) is the sharded weight update's
+# gradient reduction (`train.update_sharding=sharded`): each replica
+# receives the data-axis sum of its shard — reduced exactly once, like
+# psum, just not everywhere. The params all-gather that follows the
+# sharded update is NOT a reduction and is deliberately absent here.
+_REDUCTION_PRIMS = {"psum", "pmin", "pmax", "psum2", "reduce_scatter"}
 
 _PARAM_KEY = re.compile(r"\bparams\b")
 
@@ -244,6 +249,7 @@ def verify_repo_step(
     model_name: str = "net",
     batch_size: int = 4,
     world: int = 8,
+    update_sharding: str = "replicated",
     **model_kwargs,
 ) -> tuple[list[Finding], dict[str, int]]:
     """Verify the shipped train step's gradient-sync contract.
@@ -253,6 +259,12 @@ def verify_repo_step(
     `make_train_step_shard_map` compiles), and checks every parameter
     leaf's reduction count — under gradient accumulation too, where the
     single reduction must sit after the microbatch scan.
+
+    ``update_sharding="sharded"`` verifies the cross-replica sharded
+    weight-update program instead: there the one data-axis reduction per
+    leaf is a `reduce_scatter` (counted by `_REDUCTION_PRIMS` exactly like
+    psum), followed by a non-reducing params all-gather — so the
+    exactly-once invariant holds unchanged across both modes.
 
     Models constructed with ``axis_name`` (sync-BN) perform in-forward
     data-axis collectives whose AD transposes land on the gradient path,
@@ -264,7 +276,7 @@ def verify_repo_step(
 
     from tpu_dp.models import build_model
     from tpu_dp.parallel.dist import DATA_AXIS
-    from tpu_dp.train.optim import SGD
+    from tpu_dp.train.optim import SGD, shard_optimizer
     from tpu_dp.train.schedule import constant_lr
     from tpu_dp.train.state import create_train_state
     from tpu_dp.train.step import make_local_step
@@ -272,6 +284,8 @@ def verify_repo_step(
     model = build_model(model_name, **model_kwargs)
     exact = getattr(model, "axis_name", None) is None
     optimizer = SGD(momentum=0.9)
+    if update_sharding == "sharded":
+        optimizer = shard_optimizer(optimizer, world)
     # Sync-BN models need the data axis bound even at init; an axis-free
     # twin has the identical parameter tree and initializes anywhere.
     init_model = model if exact else build_model(
@@ -282,16 +296,24 @@ def verify_repo_step(
         init_model, jax.random.PRNGKey(0),
         np.zeros((1, 32, 32, 3), np.float32), optimizer,
     )
+    if update_sharding == "sharded":
+        # The per-shard program sees one replica's slice of the globally
+        # sharded optimizer state, not the (world,)-padded global layout.
+        state = state.replace(
+            opt_state=optimizer.local_view(state.opt_state)
+        )
     local_step = make_local_step(
         model, optimizer, constant_lr(0.1),
         accum_steps=accum_steps, world=world, axis_name=DATA_AXIS,
         cast_params=False,  # trace outside a real shard_map scope
+        update_sharding=update_sharding,
     )
     return verify_local_step(
         local_step,
         (state, _example_batch(accum_steps, batch_size)),
         axis=DATA_AXIS, world=world,
         label=f"make_local_step(model={model_name!r}, "
-              f"accum_steps={accum_steps})",
+              f"accum_steps={accum_steps}, "
+              f"update_sharding={update_sharding!r})",
         exact=exact,
     )

@@ -9,15 +9,21 @@ doubling parameter memory), whether a host callback snuck into the hot loop.
 This pass lowers the *real shipped step programs* (`tpu_dp.train.step`) on an
 abstract data mesh, compiles them, and verifies the optimized HLO text:
 
-- **DP301** — every collective in the module is classified. A DP train step
-  must compile to exactly one *combinable* gradient all-reduce group
-  (non-scalar operands, identical full-mesh replica groups, add reduction —
-  XLA's combiner pass fuses such a group into the single fused all-reduce on
-  TPU; the CPU backend leaves the ops separate, so the check is on
-  combinability, not op count) plus the declared scalar metric reductions.
-  Any all-gather / reduce-scatter / collective-permute / all-to-all, any
-  second replica grouping, and any extra scalar reduction betrays a bad
-  `PartitionSpec` in `parallel/sharding.py`.
+- **DP301** — every collective in the module is classified against the
+  step's declared update-sharding mode. *Replicated* (default): exactly one
+  *combinable* gradient all-reduce group (non-scalar operands, identical
+  full-mesh replica groups, add reduction — XLA's combiner pass fuses such
+  a group into the single fused all-reduce on TPU; the CPU backend leaves
+  the ops separate, so the check is on combinability, not op count) plus
+  the declared scalar metric reductions; any all-gather / reduce-scatter /
+  collective-permute / all-to-all, any second replica grouping, and any
+  extra scalar reduction betrays a bad `PartitionSpec` in
+  `parallel/sharding.py`. *Sharded* (`train.update_sharding=sharded`, the
+  cross-replica sharded weight update): exactly one combinable gradient
+  *reduce-scatter* group plus one params *all-gather* group over identical
+  full-mesh replica groups, plus the metric scalars — a non-scalar
+  all-reduce, a scatter/gather replica-group mismatch (wrong axis), or a
+  scatter with no gather all fire.
 - **DP302** — host transfers in the hot loop: infeed/outfeed/send/recv ops
   or host-callback custom-calls inside the step module.
 - **DP303** — donation silently dropped: every donated buffer must appear
@@ -31,9 +37,10 @@ abstract data mesh, compiles them, and verifies the optimized HLO text:
 A standalone .py file can opt in by defining ``DPLINT_HLO_PROGRAM`` — a
 zero-arg factory returning a dict with keys ``fn`` (callable to jit),
 ``args`` (example arguments), and optionally ``jit_kwargs``,
-``metric_reductions``, ``expect_grad_reduce``, ``expect_fingerprint`` —
-which is how the adversarial fixtures drive the exact pipeline the shipped
-steps go through.
+``metric_reductions``, ``expect_grad_reduce``, ``expect_fingerprint``,
+``update_sharding`` ("replicated"/"sharded" — which DP301 schedule to hold
+the module to) — which is how the adversarial fixtures drive the exact
+pipeline the shipped steps go through.
 """
 
 from __future__ import annotations
@@ -219,8 +226,18 @@ def analyze_module(
     expect_grad_reduce: bool = False,
     expect_fingerprint: str | None = None,
     donation_warnings: Sequence[str] = (),
+    update_sharding: str = "replicated",
 ) -> tuple[list[Finding], dict]:
     """Run DP301–DP304 over one compiled module's text.
+
+    ``update_sharding`` selects which collective schedule DP301 accepts as
+    legal. ``"replicated"`` (default): one combinable gradient all-reduce
+    group plus the declared scalar metric reductions, nothing else.
+    ``"sharded"`` (`train.update_sharding=sharded`): one combinable
+    gradient *reduce-scatter* group plus one params *all-gather* group over
+    the identical full-mesh replica groups, plus the metric scalars — and
+    no non-scalar all-reduce (a gradient leaf that bypassed the scatter
+    path and was all-reduced anyway defeats the sharded update).
 
     Returns (findings, record) where the record is the program's entry in
     the collective-fingerprint artifact.
@@ -235,36 +252,89 @@ def analyze_module(
                                 symbol=label))
 
     # -- DP301: classify every collective --------------------------------
-    bad_kinds = [op for op in collectives if op.kind != "all-reduce"]
+    sharded = update_sharding == "sharded"
+    legal_kinds = ("all-reduce", "reduce-scatter", "all-gather") if sharded \
+        else ("all-reduce",)
+    bad_kinds = [op for op in collectives if op.kind not in legal_kinds]
     for op in bad_kinds:
         emit("DP301",
              f"compiled program contains `{op.kind}` {op.shape} "
-             f"(replica_groups={op.replica_groups or '?'}) — a pure-DP step "
+             f"(replica_groups={op.replica_groups or '?'}) — a "
+             f"{'sharded-update' if sharded else 'pure-DP'} step "
              f"needs no {op.kind}; an extra collective here means a batch "
              f"or parameter dimension is sharded/replicated against the "
              f"declared PartitionSpec (parallel/sharding.py)")
     allreduces = [op for op in collectives if op.kind == "all-reduce"]
-    grad_ars = [op for op in allreduces if not op.is_scalar]
+    scatters = [op for op in collectives if op.kind == "reduce-scatter"]
+    gathers = [op for op in collectives if op.kind == "all-gather"]
     metric_ars = [op for op in allreduces if op.is_scalar]
-    groups = {op.replica_groups for op in allreduces}
-    if len(groups) > 1:
-        emit("DP301",
-             f"all-reduces use {len(groups)} distinct replica groupings "
-             f"({sorted(groups)}) — the data-parallel step has one axis, so "
-             f"every reduction must span the same full-mesh group")
-    non_add = sorted({op.reduction for op in grad_ars
-                      if op.reduction and op.reduction != "add"})
-    if non_add:
-        emit("DP301",
-             f"gradient all-reduce group mixes reduction kinds "
-             f"(add + {non_add}) — a non-add reduction on the gradient path "
-             f"cannot fuse into the single combined all-reduce")
-    if expect_grad_reduce and world > 1 and not grad_ars:
-        emit("DP301",
-             "no non-scalar all-reduce in the compiled train step — the "
-             "gradient all-reduce the DDP contract requires was never "
-             "materialized by the partitioner (replicas would silently "
-             "diverge)")
+    if sharded:
+        grad_ars = scatters
+        stray_ars = [op for op in allreduces if not op.is_scalar]
+        for op in stray_ars:
+            emit("DP301",
+                 f"non-scalar `all-reduce` {op.shape} in a sharded-update "
+                 f"step — that leaf's gradient bypassed the reduce-scatter "
+                 f"path and is being fully reduced + updated on every "
+                 f"replica, defeating train.update_sharding=sharded")
+        scatter_groups = {op.replica_groups for op in scatters}
+        gather_groups = {op.replica_groups for op in gathers}
+        if len(scatter_groups) > 1:
+            emit("DP301",
+                 f"reduce-scatters use {len(scatter_groups)} distinct "
+                 f"replica groupings ({sorted(scatter_groups)}) — one data "
+                 f"axis means one combinable scatter group")
+        if len(gather_groups) > 1:
+            emit("DP301",
+                 f"all-gathers use {len(gather_groups)} distinct replica "
+                 f"groupings ({sorted(gather_groups)}) — one data axis "
+                 f"means one combinable gather group")
+        if scatters and gathers and scatter_groups != gather_groups:
+            emit("DP301",
+                 f"reduce-scatter replica groups {sorted(scatter_groups)} "
+                 f"do not match all-gather replica groups "
+                 f"{sorted(gather_groups)} — the update's scatter and the "
+                 f"params gather run over different axes, so each replica "
+                 f"updates one shard but gathers another (silently wrong "
+                 f"params on every replica)")
+        if scatters and not gathers and world > 1:
+            emit("DP301",
+                 "reduce-scatter with no matching all-gather — updated "
+                 "parameter shards are never reassembled; the next step's "
+                 "forward pass would run on stale full params")
+        non_add = sorted({op.reduction for op in scatters
+                          if op.reduction and op.reduction != "add"})
+        if non_add:
+            emit("DP301",
+                 f"gradient reduce-scatter group mixes reduction kinds "
+                 f"(add + {non_add}) — a non-add reduction on the gradient "
+                 f"path cannot fuse into the single combined reduce-scatter")
+        if expect_grad_reduce and world > 1 and not scatters:
+            emit("DP301",
+                 "no reduce-scatter in the compiled sharded-update train "
+                 "step — the gradient reduction the DDP contract requires "
+                 "was never materialized (replicas would silently diverge)")
+    else:
+        grad_ars = [op for op in allreduces if not op.is_scalar]
+        groups = {op.replica_groups for op in allreduces}
+        if len(groups) > 1:
+            emit("DP301",
+                 f"all-reduces use {len(groups)} distinct replica groupings "
+                 f"({sorted(groups)}) — the data-parallel step has one axis, "
+                 f"so every reduction must span the same full-mesh group")
+        non_add = sorted({op.reduction for op in grad_ars
+                          if op.reduction and op.reduction != "add"})
+        if non_add:
+            emit("DP301",
+                 f"gradient all-reduce group mixes reduction kinds "
+                 f"(add + {non_add}) — a non-add reduction on the gradient "
+                 f"path cannot fuse into the single combined all-reduce")
+        if expect_grad_reduce and world > 1 and not grad_ars:
+            emit("DP301",
+                 "no non-scalar all-reduce in the compiled train step — the "
+                 "gradient all-reduce the DDP contract requires was never "
+                 "materialized by the partitioner (replicas would silently "
+                 "diverge)")
     if len(metric_ars) > metric_reductions:
         emit("DP301",
              f"{len(metric_ars)} scalar all-reduce(s) compiled, "
@@ -312,9 +382,16 @@ def analyze_module(
 
     record = {
         "digest": digest,
+        # The fingerprint artifact names the schedule mode explicitly: the
+        # digest already separates the two (different op kinds digest
+        # differently), but a reviewer diffing the artifact should not have
+        # to infer the mode from the op list.
+        "update_sharding": update_sharding,
         "collectives": [op.to_dict() for op in collectives],
         "counts": count_collectives(text),
-        "grad_allreduce_ops": len(grad_ars),
+        # Mode-neutral name: in sharded mode the gradient-reduction ops are
+        # the reduce-scatter group, not non-scalar all-reduces.
+        "grad_reduce_ops": len(grad_ars),
         "metric_allreduce_ops": len(metric_ars),
         "donated_inputs": donated_leaves,
         "aliased_inputs": len(aliased),
@@ -365,7 +442,7 @@ def shipped_programs(
     from tpu_dp.models import build_model
     from tpu_dp.parallel import dist
     from tpu_dp.train import step as step_mod
-    from tpu_dp.train.optim import SGD
+    from tpu_dp.train.optim import SGD, shard_optimizer
     from tpu_dp.train.schedule import constant_lr
     from tpu_dp.train.state import create_train_state
 
@@ -378,17 +455,23 @@ def shipped_programs(
         model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
         opt,
     )
+    sharded_opt = shard_optimizer(SGD(momentum=0.9), world)
+    sharded_state = create_train_state(
+        model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
+        sharded_opt,
+    )
     n_state = len(jax.tree_util.tree_leaves(state))
     batch = 2 * world
     path = _step_py_path()
 
-    def spec(factory, donated, metrics, grad):
+    def spec(factory, donated, metrics, grad, mode="replicated"):
         return {
             "donated_leaves": donated,
             "metric_reductions": metrics,
             "expect_grad_reduce": grad,
             "where": (path, factory.__code__.co_firstlineno),
             "world": world,
+            "update_sharding": mode,
         }
 
     for accum in accum_steps:
@@ -406,11 +489,32 @@ def shipped_programs(
         (state, _example_batch(batch)),
         spec(step_mod.make_train_step_shard_map, n_state, 2, True),
     )
+    # The sharded weight update's second legal schedule: one combinable
+    # reduce-scatter group + one all-gather group (DP301 sharded mode).
+    for accum in accum_steps:
+        prefix = () if accum == 1 else (accum,)
+        yield (
+            f"train_step[shard_map,sharded]@accum{accum}",
+            step_mod.make_train_step_shard_map(
+                model, sharded_opt, mesh, sched, accum_steps=accum,
+                update_sharding="sharded",
+            ),
+            (sharded_state, _example_batch(batch, prefix)),
+            spec(step_mod.make_train_step_shard_map, n_state, 2, True,
+                 mode="sharded"),
+        )
     yield (
         "multi_step@w2",
         step_mod.make_multi_step(model, opt, mesh, sched, num_steps=2),
         (state, _example_batch(batch, (2,))),
         spec(step_mod.make_multi_step, n_state, 2, True),
+    )
+    yield (
+        "multi_step[sharded]@w2",
+        step_mod.make_multi_step(model, sharded_opt, mesh, sched,
+                                 num_steps=2, update_sharding="sharded"),
+        (sharded_state, _example_batch(batch, (2,))),
+        spec(step_mod.make_multi_step, n_state, 2, True, mode="sharded"),
     )
     yield (
         "eval_step",
@@ -442,6 +546,7 @@ def verify_repo_hlo(
             metric_reductions=spec["metric_reductions"],
             expect_grad_reduce=spec["expect_grad_reduce"],
             donation_warnings=donation_warns,
+            update_sharding=spec.get("update_sharding", "replicated"),
         )
         findings.extend(got)
         record.update(stats)
@@ -485,6 +590,29 @@ def program_fingerprint(jitted: Callable, args: Sequence[Any]) -> str:
 HLO_HOOK = "DPLINT_HLO_PROGRAM"
 
 
+def _hook_line(fn: Any, path: str) -> int:
+    """Line to attribute a hook program's findings to.
+
+    Walks the ``__wrapped__`` chain (jit → shard_map wrapper → user fn)
+    preferring the first code object defined in the hook file itself — a
+    program wrapped in transformation layers must not attribute its
+    findings to a line number inside jax internals.
+    """
+    best = None
+    seen: set[int] = set()
+    node = fn
+    while node is not None and id(node) not in seen:
+        seen.add(id(node))
+        code = getattr(node, "__code__", None)
+        if code is not None:
+            if os.path.abspath(code.co_filename) == os.path.abspath(path):
+                return code.co_firstlineno
+            if best is None:
+                best = code.co_firstlineno
+        node = getattr(node, "__wrapped__", None)
+    return best if best is not None else 1
+
+
 def verify_hlo_hook(path: str, module: Any, world: int) -> list[Finding]:
     """Compile and verify a file's ``DPLINT_HLO_PROGRAM`` declaration."""
     import jax
@@ -518,10 +646,7 @@ def verify_hlo_hook(path: str, module: Any, world: int) -> list[Finding]:
             )
         donated_leaves = len(donated_idx)
 
-    code = getattr(fn, "__code__", None) or getattr(
-        getattr(fn, "__wrapped__", None), "__code__", None
-    )
-    line = code.co_firstlineno if code else 1
+    line = _hook_line(fn, path)
     text, _, donation_warns = lower_and_compile(jitted, args)
     findings, _ = analyze_module(
         text,
@@ -533,5 +658,6 @@ def verify_hlo_hook(path: str, module: Any, world: int) -> list[Finding]:
         expect_grad_reduce=bool(decl.get("expect_grad_reduce", False)),
         expect_fingerprint=decl.get("expect_fingerprint"),
         donation_warnings=donation_warns,
+        update_sharding=str(decl.get("update_sharding", "replicated")),
     )
     return findings

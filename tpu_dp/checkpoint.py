@@ -34,8 +34,24 @@ _CKPT_NAME = "state.msgpack"
 _META_NAME = "meta.json"
 
 
+def leaf_to_host(x) -> np.ndarray:
+    """One leaf → host numpy, whatever its device layout.
+
+    Replicated leaves are a straight copy. Leaves sharded across *processes*
+    (the sharded-update optimizer state, `train.update_sharding=sharded`)
+    are not fully addressable, so the global value is assembled with an
+    across-host allgather — the checkpoint always stores the canonical
+    global array, never one host's shard.
+    """
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def _to_host(tree):
-    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+    return jax.tree_util.tree_map(leaf_to_host, tree)
 
 
 def _atomic_write_state(
@@ -64,13 +80,83 @@ def save_checkpoint(
     return _atomic_write_state(Path(ckpt_dir), _to_host(state), meta)
 
 
+def _relayout_opt_leaf(saved: np.ndarray, like: np.ndarray,
+                       where: str) -> np.ndarray:
+    """Reshard one saved optimizer-state leaf onto ``like``'s layout.
+
+    The sharded weight update (`train.update_sharding=sharded`) stores each
+    opt-state leaf as a flat 1-D array zero-padded to a multiple of the
+    world size — a layout that depends on the topology it was written
+    under. This relayout is value-preserving across every transition
+    because only zeros are ever added or dropped:
+
+    - flat(world A) → flat(world B): truncate or zero-extend (the tail
+      beyond the true element count is padding by construction);
+    - replicated → flat: flatten + zero-pad;
+    - flat → replicated: take the leading true-count elements, reshape.
+    """
+    saved = np.asarray(saved)
+    if saved.shape == tuple(like.shape):
+        return saved
+    flat = saved.reshape(-1)
+    if like.ndim == 1:
+        out = np.zeros(like.shape[0], dtype=like.dtype)
+        k = min(out.size, flat.size)
+        out[:k] = flat[:k]
+        return out
+    if flat.size < like.size:
+        raise ValueError(
+            f"checkpoint opt_state leaf {where}: saved {saved.shape} has "
+            f"{flat.size} elements, target {tuple(like.shape)} needs "
+            f"{like.size} — not a shard-layout transition"
+        )
+    return flat[: like.size].reshape(like.shape).astype(like.dtype)
+
+
+def _maybe_reshard_opt_state(raw: Any, host_target: TrainState) -> Any:
+    """Relayout ``raw['opt_state']`` onto the target's shard layout.
+
+    A checkpoint written under one topology/update-sharding mode restores
+    under another: leaf shapes that already match pass through untouched
+    (the common case — and the fast path `from_state_dict` would take
+    anyway); a structural mismatch is left for `from_state_dict` to
+    diagnose (it is a different-optimizer error, not a layout one).
+    """
+    if not isinstance(raw, dict) or "opt_state" not in raw:
+        return raw
+    target_sd = serialization.to_state_dict(host_target)
+    saved_opt, target_opt = raw["opt_state"], target_sd.get("opt_state")
+    s_leaves, s_def = jax.tree_util.tree_flatten(saved_opt)
+    t_leaves, t_def = jax.tree_util.tree_flatten(target_opt)
+    if s_def != t_def:
+        return raw
+    paths = jax.tree_util.tree_leaves_with_path(saved_opt)
+    new_leaves = [
+        _relayout_opt_leaf(s, t, jax.tree_util.keystr(p))
+        for (p, _), s, t in zip(paths, s_leaves, t_leaves)
+    ]
+    raw = dict(raw)
+    raw["opt_state"] = jax.tree_util.tree_unflatten(s_def, new_leaves)
+    return raw
+
+
 def load_checkpoint(
     ckpt_dir: str | os.PathLike, target: TrainState
 ) -> tuple[TrainState, dict[str, Any]]:
-    """Restore a `TrainState` (shaped like `target`) + metadata."""
+    """Restore a `TrainState` (shaped like `target`) + metadata.
+
+    Optimizer state is resharded onto ``target``'s layout when the
+    checkpoint was written under a different topology or
+    ``train.update_sharding`` mode (`_relayout_opt_leaf`) — a run killed on
+    8 chips resumes on 4, and a replicated checkpoint upgrades to the
+    sharded update in place.
+    """
     ckpt_dir = Path(ckpt_dir)
     payload = (ckpt_dir / _CKPT_NAME).read_bytes()
-    state = serialization.from_bytes(_to_host(target), payload)
+    host_target = _to_host(target)
+    raw = serialization.msgpack_restore(payload)
+    raw = _maybe_reshard_opt_state(raw, host_target)
+    state = serialization.from_state_dict(host_target, raw)
     meta_path = ckpt_dir / _META_NAME
     meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
     return state, meta

@@ -97,6 +97,17 @@ class TrainConfig:
     # sequence and compares against rank 0 — desynced binaries fail fast
     # instead of deadlocking mid-step. Costs one AOT compile; off by default.
     verify_fingerprint: bool = False
+    # Cross-replica sharded weight update (Xu et al., PAPERS.md;
+    # docs/PERF.md): "replicated" = gradient all-reduce + full update on
+    # every replica (the default, GSPMD path); "sharded" = reduce-scatter
+    # the grads, update 1/N of the params + optimizer state per replica,
+    # all-gather the updated params (explicit-collectives shard_map path;
+    # opt state persists sharded over the data axis).
+    update_sharding: str = "replicated"
+    # Wire dtype for the gradient reduce-scatter in sharded mode ("" =
+    # reduce in the leaf dtype; "bf16" halves the bytes on the wire at
+    # bf16 rounding cost — the EQuARX-style compressed-collective knob).
+    collective_dtype: str = ""
 
 
 @dataclass

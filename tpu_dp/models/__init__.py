@@ -18,6 +18,11 @@ _REGISTRY = {
 # Models that understand the ResNet-only kwargs (fused Pallas stages etc.).
 _RESNETS = {"resnet18", "resnet50"}
 
+# Models carrying BatchNorm, i.e. the ones that accept ``axis_name`` for
+# sync-BN inside shard_map (one source of truth — the trainer keys its
+# sharded-update model construction off this, not a second name list).
+BATCHNORM_MODELS = frozenset(_RESNETS)
+
 
 def parse_fused_stages(spec: str | None) -> tuple[int, ...]:
     """Parse `ModelConfig.fused_stages`: '' -> none, 'all' -> all four
@@ -55,6 +60,6 @@ def build_model(name: str, num_classes: int = 10, **kwargs):
 
 
 __all__ = [
-    "Net", "ResNet", "ResNet18", "ResNet50", "build_model",
-    "parse_fused_stages",
+    "BATCHNORM_MODELS", "Net", "ResNet", "ResNet18", "ResNet50",
+    "build_model", "parse_fused_stages",
 ]

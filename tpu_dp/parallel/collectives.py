@@ -1,6 +1,6 @@
 """Collective helpers over the named mesh axis.
 
-The only collective *primitives* parity with the reference requires are
+The collective *primitives* parity with the reference requires are
 allreduce(mean/sum) and barrier (SURVEY.md §5 "Distributed communication
 backend"): NCCL allreduce-mean backs DDP's gradient hooks
 (`cifar_example_ddp.py:83`) and allreduce-sum backs torchmetrics' state sync
@@ -9,6 +9,17 @@ inside `shard_map` they are `lax.pmean`/`lax.psum` on the ``data`` axis, and
 under plain `jit` with sharding annotations GSPMD inserts them automatically.
 A host-side CPU ring-allreduce fallback (C++, `tpu_dp.ops.native`) backs the
 same semantics for host-only coordination outside any compiled program.
+
+The sharded weight-update path (`train.update_sharding=sharded`; Xu et al.,
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training", PAPERS.md) decomposes the gradient all-reduce into its two ring
+halves and moves the optimizer in between: ``psum_scatter`` (each replica
+receives the *sum* of one 1/N shard of every gradient leaf), a per-shard
+update, then ``all_gather`` of the updated parameters. The wrappers here own
+the one non-trivial piece of that decomposition: flattening + zero-padding
+every leaf to a multiple of the axis size, so leaves whose element counts do
+not divide the mesh (CIFAR `Net`'s f32[5,5,3,6] on 8 chips) shard exactly
+like the rest, and un-padding on the gather side.
 """
 
 from __future__ import annotations
@@ -16,6 +27,7 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 from tpu_dp.parallel.dist import DATA_AXIS
@@ -40,3 +52,94 @@ def psum(tree: Any, axis_name: str = DATA_AXIS) -> Any:
     (`cifar_example_ddp.py:124,133`).
     """
     return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), tree)
+
+
+def padded_size(n: int, world: int) -> int:
+    """``n`` rounded up to a multiple of ``world`` (the flat shard layout)."""
+    return n + (-n) % world
+
+
+def shard_size(n: int, world: int) -> int:
+    """Per-replica elements of a flat-sharded leaf with ``n`` elements."""
+    return padded_size(n, world) // world
+
+
+def _flat_padded(x: jnp.ndarray, world: int) -> jnp.ndarray:
+    """Leaf flattened to 1-D and zero-padded to a multiple of ``world``."""
+    flat = x.reshape(-1)
+    pad = (-flat.size) % world
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat
+
+
+def psum_scatter(
+    tree: Any,
+    axis_name: str = DATA_AXIS,
+    *,
+    world: int,
+    mean: bool = False,
+    dtype: Any = None,
+) -> Any:
+    """Reduce-scatter a pytree: each replica gets the sum of its 1/world shard.
+
+    The first ring half of the gradient all-reduce, with the second half
+    (`all_gather`) deferred until after the per-shard optimizer update — the
+    cross-replica-sharded weight update of Xu et al. (PAPERS.md). Every leaf
+    is flattened and zero-padded to a multiple of ``world`` (`_flat_padded`),
+    so the output leaf is 1-D of `shard_size(leaf.size, world)` elements.
+    ``mean=True`` divides by ``world`` (DDP gradient averaging). ``dtype``
+    optionally casts the payload *before* the collective and back after —
+    the EQuARX-style compressed-collective knob (`train.collective_dtype`):
+    half the bytes on the wire for bf16, at bf16 rounding cost.
+    """
+
+    def scatter(x):
+        out_dtype = x.dtype
+        if dtype is not None:
+            x = x.astype(dtype)
+        shard = lax.psum_scatter(
+            _flat_padded(x, world), axis_name, scatter_dimension=0, tiled=True
+        ).astype(out_dtype)
+        if mean:
+            # Divide in the output dtype (after any compressed-wire cast):
+            # matches pmean's psum-then-divide ordering, so the f32 path is
+            # bitwise-identical to the replicated update.
+            shard = shard / world
+        return shard
+
+    return jax.tree_util.tree_map(scatter, tree)
+
+
+def shard_slice(tree: Any, axis_name: str = DATA_AXIS, *, world: int) -> Any:
+    """This replica's 1/world flat shard of every (replicated) leaf.
+
+    Pure local slicing — no communication: replica i of the flattened,
+    zero-padded leaf takes elements [i*chunk, (i+1)*chunk). The layout
+    twin of `psum_scatter`'s output, used to pair parameter shards with
+    reduce-scattered gradient shards for the per-shard optimizer update.
+    """
+
+    def slice_leaf(x):
+        flat = _flat_padded(x, world)
+        chunk = flat.size // world
+        idx = lax.axis_index(axis_name)
+        return lax.dynamic_slice_in_dim(flat, idx * chunk, chunk)
+
+    return jax.tree_util.tree_map(slice_leaf, tree)
+
+
+def all_gather(shards: Any, like: Any, axis_name: str = DATA_AXIS) -> Any:
+    """Reassemble flat 1/world shards into leaves shaped like ``like``.
+
+    The second ring half of the decomposed all-reduce: concatenate every
+    replica's shard (tiled all-gather), drop the zero padding, restore the
+    original shape/dtype. `all_gather(psum_scatter(t, mean=True), t)` is
+    numerically `pmean(t)` — the parity test asserts it bitwise for f32.
+    """
+
+    def gather(shard, ref):
+        full = lax.all_gather(shard, axis_name, axis=0, tiled=True)
+        return full[: ref.size].reshape(ref.shape).astype(ref.dtype)
+
+    return jax.tree_util.tree_map(gather, shards, like)

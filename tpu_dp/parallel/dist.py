@@ -162,6 +162,14 @@ def data_mesh(
     return Mesh(np.asarray(devices), (DATA_AXIS,))
 
 
+def data_axis_size(mesh: Mesh) -> int:
+    """Replica count of the ``data`` axis — the world every collective,
+    gradient mean, and update-shard layout keys off. One accessor so code
+    never conflates the data-axis size with ``mesh.devices.size`` (equal
+    today, not once the reserved ``model`` axis gets a real extent)."""
+    return int(mesh.shape[DATA_AXIS])
+
+
 _BARRIER_TRACES = [0]  # trace-count observable for tests
 
 

@@ -31,7 +31,7 @@ from typing import Any
 import jax
 import numpy as np
 
-from tpu_dp.checkpoint import CheckpointManager
+from tpu_dp.checkpoint import CheckpointManager, leaf_to_host
 
 
 class SnapshotManager:
@@ -65,11 +65,17 @@ class SnapshotManager:
         self._slot ^= 1
         buf = self._buffers[slot]
         if buf is None:
-            buf = [np.array(x) for x in leaves]
+            # leaf_to_host assembles cross-process-sharded opt-state leaves
+            # (`train.update_sharding=sharded`) into their canonical global
+            # layout; the np.array wrap is NOT redundant — on the CPU
+            # backend np.asarray of a jax array can be a read-only alias of
+            # device memory, and the buffer must be a writable owned copy
+            # (the reuse path np.copyto's into it).
+            buf = [np.array(leaf_to_host(x)) for x in leaves]
             self._buffers[slot] = buf
         else:
             for dst, src in zip(buf, leaves):
-                np.copyto(dst, np.asarray(src))
+                np.copyto(dst, leaf_to_host(src))
         return jax.tree_util.tree_unflatten(treedef, buf)
 
     def due(self, global_step: int) -> bool:

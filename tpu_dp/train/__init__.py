@@ -7,7 +7,7 @@ allreduce becomes ONE compiled XLA program per step — forward, backward,
 cross-chip gradient mean, and the SGD update fused and scheduled together.
 """
 
-from tpu_dp.train.optim import SGD, Optimizer
+from tpu_dp.train.optim import SGD, Optimizer, ShardedUpdate, shard_optimizer
 from tpu_dp.train.schedule import constant_lr, cosine_lr, make_schedule
 from tpu_dp.train.state import TrainState, create_train_state
 from tpu_dp.train.step import (
@@ -23,8 +23,10 @@ from tpu_dp.train.trainer import Trainer
 __all__ = [
     "SGD",
     "Optimizer",
+    "ShardedUpdate",
     "Trainer",
     "TrainState",
+    "shard_optimizer",
     "constant_lr",
     "cosine_lr",
     "create_train_state",

@@ -15,6 +15,15 @@ grad ← grad + wd·p before the momentum accumulation.
 
 The learning rate is a traced scalar input, so LR schedules (BASELINE.json
 config 5's cosine) change no compiled code.
+
+`ShardedUpdate` wraps any such pytree optimizer into the cross-replica
+*sharded* weight update of Xu et al. (PAPERS.md, `train.update_sharding=
+sharded`): the step hands it reduce-scattered gradient shards, it slices the
+matching 1/world parameter shards locally, runs the wrapped update on 1/world
+of every leaf, and all-gathers only the updated parameters — optimizer state
+(momentum, and any future slots) lives permanently sharded over the data
+axis, cutting its per-replica memory to ~1/world and the update FLOPs with
+it.
 """
 
 from __future__ import annotations
@@ -85,3 +94,92 @@ class SGD:
             lambda p, b: p - lr * b, params, new_buf
         )
         return new_params, new_buf
+
+
+class ShardedUpdate:
+    """Cross-replica sharded weight update over ``axis_name`` (Xu et al.).
+
+    Wraps a pytree optimizer so the update runs on 1/world of every leaf:
+
+        grad shards (from `collectives.psum_scatter`, flat 1-D)
+          + param shards (local `collectives.shard_slice`, no comms)
+          → inner.update on the shards
+          → `collectives.all_gather` of the updated params only.
+
+    Contract with the step factories (`train.step`): the gradients handed to
+    ``update`` are *already* reduce-scattered flat shards — the reduce hook
+    in `make_local_step(update_sharding="sharded")` produced them — while
+    ``params`` are the full replicated leaves. ``opt_state`` is created by
+    this class's ``init`` and is permanently shard-laid-out: each leaf is
+    flat 1-D of `padded_size(n, world)` elements globally, sharded over the
+    data axis (per-replica view inside `shard_map`: `shard_size(n, world)`
+    elements — ~1/world of the replicated layout's memory).
+
+    Weight decay and the decay-exclusion mask live in the wrapped optimizer
+    and work unchanged: the shard trees preserve the param tree structure
+    (`tree_map_with_path` sees the same key paths), and decay's
+    ``g + wd·p`` is elementwise, so shard-wise == full-tensor.
+    """
+
+    is_sharded_update = True  # step-factory handshake (duck-typed)
+
+    def __init__(self, inner: "Optimizer", world: int,
+                 axis_name: str | None = None):
+        from tpu_dp.parallel.dist import DATA_AXIS
+
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        self.inner = inner
+        self.world = int(world)
+        self.axis_name = DATA_AXIS if axis_name is None else axis_name
+
+    def init(self, params):
+        """Shard-laid-out optimizer state: global view, host-side.
+
+        Each inner-state leaf becomes flat 1-D of `padded_size(n, world)`
+        zeros; jit's ``in_shardings`` (P over the data axis) slices it to
+        `shard_size(n, world)` per replica. Runs on host (no axis bound), so
+        it builds the *global* layout the per-shard program's out_specs
+        stitch back together.
+        """
+        from tpu_dp.parallel.collectives import padded_size
+
+        inner_state = self.inner.init(params)
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros((padded_size(s.size, self.world),), s.dtype),
+            inner_state,
+        )
+
+    def local_view(self, opt_state):
+        """Per-replica slice of a global-layout ``opt_state`` (leaf[:n/w]).
+
+        What one replica sees inside `shard_map` — used by the analyzers to
+        trace the per-shard program outside a real shard_map scope, and by
+        tests asserting the ~1/world memory claim.
+        """
+        return jax.tree_util.tree_map(
+            lambda s: s[: s.size // self.world], opt_state
+        )
+
+    def update(self, grad_shards, opt_state, params, lr):
+        """Per-shard update; returns (full new_params, sharded new state)."""
+        from tpu_dp.parallel import collectives
+
+        param_shards = collectives.shard_slice(
+            params, self.axis_name, world=self.world
+        )
+        new_param_shards, new_opt_state = self.inner.update(
+            grad_shards, opt_state, param_shards, lr
+        )
+        new_params = collectives.all_gather(
+            new_param_shards, params, self.axis_name
+        )
+        return new_params, new_opt_state
+
+
+def shard_optimizer(optimizer: "Optimizer", world: int,
+                    axis_name: str | None = None) -> ShardedUpdate:
+    """`ShardedUpdate` over ``optimizer``. World 1 is the same code path
+    with degenerate (1-replica) collectives — one layout everywhere, so a
+    sharded checkpoint written on one topology restores on any other."""
+    return ShardedUpdate(optimizer, world, axis_name)

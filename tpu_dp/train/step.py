@@ -330,6 +330,10 @@ def make_train_step(
     (`cifar_example.py:83-87`) plus what its synced eval metric accumulates
     (`cifar_example_ddp.py:133`).
     """
+    # The GSPMD path is replicated-update only (the sharded update needs
+    # explicit collectives — `make_train_step_shard_map`); reject a
+    # sharded-layout optimizer at the factory boundary.
+    _check_update_sharding("replicated", optimizer)
     repl = replicated_sharding(mesh)
     batch_sh = batch_sharding(mesh)
     loss_impl = _select_loss_impl(use_pallas_xent)
@@ -357,6 +361,8 @@ def make_multi_step(
     use_pallas_xent: bool = False,
     augment_fn: Callable | None = None,
     accum_steps: int = 1,
+    update_sharding: str = "replicated",
+    collective_dtype: str | None = None,
 ) -> Callable:
     """Device-side training loop: ``num_steps`` train steps in ONE program.
 
@@ -382,12 +388,32 @@ def make_multi_step(
     window elements performs one accumulated optimizer update — BASELINE
     config 5's global-batch-4096 recipe running windowed on a small mesh,
     where both amortizations (dispatch RTT and HBM) are needed at once.
+
+    ``update_sharding="sharded"`` runs the window over the explicit
+    sharded-weight-update body (`make_local_step` — reduce-scatter →
+    1/world update → params all-gather inside every scanned step, opt state
+    permanently sharded over ``data``); ``optimizer`` must then be a
+    `train.optim.ShardedUpdate`, as for `make_train_step_shard_map`.
     """
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dp.parallel.dist import DATA_AXIS, data_axis_size
+
     repl = replicated_sharding(mesh)
     loss_impl = _select_loss_impl(use_pallas_xent)
 
-    body = _select_body(model, optimizer, schedule, loss_impl, augment_fn,
-                        accum_steps)
+    if update_sharding == "sharded":
+        body = make_local_step(
+            model, optimizer, schedule, use_pallas_xent=use_pallas_xent,
+            accum_steps=accum_steps, augment_fn=augment_fn,
+            world=data_axis_size(mesh), axis_name=DATA_AXIS,
+            update_sharding=update_sharding,
+            collective_dtype=collective_dtype,
+        )
+    else:
+        _check_update_sharding(update_sharding, optimizer)
+        body = _select_body(model, optimizer, schedule, loss_impl,
+                            augment_fn, accum_steps)
 
     def loop(state: TrainState, batches):
         pool = jax.tree_util.tree_leaves(batches)[0].shape[0]
@@ -409,13 +435,25 @@ def make_multi_step(
 
     # Scan axis (and, with accumulation, the microbatch-stack axis) in
     # front, batch dim sharded over data.
-    in_batch_sh = scan_batch_sharding(
-        mesh, prefix_dims=1 if accum_steps == 1 else 2
-    )
+    prefix_dims = 1 if accum_steps == 1 else 2
+    in_batch_sh = scan_batch_sharding(mesh, prefix_dims=prefix_dims)
+    state_sh = _state_shardings(mesh, update_sharding)
+    run = loop
+    if update_sharding == "sharded":
+        # The explicit-collectives window: the whole scan runs per-shard
+        # under shard_map, each scanned step performing the reduce-scatter /
+        # sharded-update / all-gather sequence of `make_local_step`.
+        batch_spec = P(*([None] * prefix_dims), DATA_AXIS)
+        run = _shard_map(
+            loop,
+            mesh=mesh,
+            in_specs=(_state_specs(update_sharding), batch_spec),
+            out_specs=(_state_specs(update_sharding), P()),
+        )
     return jax.jit(
-        loop,
-        in_shardings=(repl, in_batch_sh),
-        out_shardings=(repl, repl),
+        run,
+        in_shardings=(state_sh, in_batch_sh),
+        out_shardings=(state_sh, repl),
         donate_argnums=(0,),
     )
 
@@ -429,6 +467,8 @@ def make_multi_step_resident(
     use_pallas_xent: bool = False,
     augment_fn: Callable | None = None,
     accum_steps: int = 1,
+    update_sharding: str = "replicated",
+    collective_dtype: str | None = None,
 ) -> Callable:
     """Windowed training loop fed by a device-resident dataset + indices.
 
@@ -448,11 +488,31 @@ def make_multi_step_resident(
     ``data`` leaves are (N, ...) device-resident (replicated; uint8 images
     fine — normalization is in-body), ``idx`` is int32 with the window axis
     in front. Only ``state`` is donated — ``data`` must survive the call.
+
+    ``update_sharding="sharded"`` composes the resident feed with the
+    sharded weight update: the indices shard over ``data`` (each replica
+    gathers only its shard's examples from the replicated dataset) and the
+    scanned body is the explicit reduce-scatter / 1/world-update /
+    all-gather step of `make_local_step`.
     """
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dp.parallel.dist import DATA_AXIS, data_axis_size
+
     repl = replicated_sharding(mesh)
     loss_impl = _select_loss_impl(use_pallas_xent)
-    body = _select_body(model, optimizer, schedule, loss_impl, augment_fn,
-                        accum_steps)
+    if update_sharding == "sharded":
+        body = make_local_step(
+            model, optimizer, schedule, use_pallas_xent=use_pallas_xent,
+            accum_steps=accum_steps, augment_fn=augment_fn,
+            world=data_axis_size(mesh), axis_name=DATA_AXIS,
+            update_sharding=update_sharding,
+            collective_dtype=collective_dtype,
+        )
+    else:
+        _check_update_sharding(update_sharding, optimizer)
+        body = _select_body(model, optimizer, schedule, loss_impl,
+                            augment_fn, accum_steps)
 
     def loop(state: TrainState, data, idx):
         def indexed_body(st, idx_step):
@@ -463,14 +523,106 @@ def make_multi_step_resident(
         # time instead of silently running a different number of steps.
         return jax.lax.scan(indexed_body, state, idx, length=num_steps)
 
-    idx_sh = scan_batch_sharding(
-        mesh, prefix_dims=1 if accum_steps == 1 else 2
-    )
+    prefix_dims = 1 if accum_steps == 1 else 2
+    idx_sh = scan_batch_sharding(mesh, prefix_dims=prefix_dims)
+    state_sh = _state_shardings(mesh, update_sharding)
+    run = loop
+    if update_sharding == "sharded":
+        idx_spec = P(*([None] * prefix_dims), DATA_AXIS)
+        run = _shard_map(
+            loop,
+            mesh=mesh,
+            in_specs=(_state_specs(update_sharding), P(), idx_spec),
+            out_specs=(_state_specs(update_sharding), P()),
+        )
     return jax.jit(
-        loop,
-        in_shardings=(repl, repl, idx_sh),
-        out_shardings=(repl, repl),
+        run,
+        in_shardings=(state_sh, repl, idx_sh),
+        out_shardings=(state_sh, repl),
         donate_argnums=(0,),
+    )
+
+
+UPDATE_SHARDING_MODES = ("replicated", "sharded")
+
+
+def _check_update_sharding(update_sharding: str, optimizer) -> None:
+    """Fail fast on a mode/optimizer mismatch.
+
+    The sharded layout is a *contract* between three parties — the reduce
+    hook (flat grad shards out), the optimizer (`ShardedUpdate`: shard-
+    shaped state, param-shard slicing, params all-gather), and the state
+    created from that optimizer's `init`. A plain optimizer in sharded mode
+    (or vice versa) would trace to shape errors deep inside the update;
+    diagnose it at the factory boundary instead.
+    """
+    if update_sharding not in UPDATE_SHARDING_MODES:
+        raise ValueError(
+            f"update_sharding must be one of {UPDATE_SHARDING_MODES}, "
+            f"got {update_sharding!r}"
+        )
+    is_sharded_opt = getattr(optimizer, "is_sharded_update", False)
+    if update_sharding == "sharded" and not is_sharded_opt:
+        raise ValueError(
+            "update_sharding='sharded' requires a ShardedUpdate optimizer "
+            "(train.optim.shard_optimizer) so the TrainState's opt_state "
+            "was initialized in the sharded layout"
+        )
+    if update_sharding == "replicated" and is_sharded_opt:
+        raise ValueError(
+            "replicated update with a ShardedUpdate optimizer: the opt "
+            "state layouts are incompatible; pass the inner optimizer"
+        )
+
+
+def _parse_collective_dtype(collective_dtype: str | None):
+    """`train.collective_dtype` → jnp dtype for the wire format (or None)."""
+    if not collective_dtype:
+        return None
+    allowed = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+               "f32": None, "float32": None}
+    if collective_dtype not in allowed:
+        raise ValueError(
+            f"collective_dtype must be one of {sorted(allowed)} (or empty), "
+            f"got {collective_dtype!r}"
+        )
+    return allowed[collective_dtype]
+
+
+def _state_specs(update_sharding: str):
+    """PartitionSpec pytree-prefix for a TrainState under ``update_sharding``.
+
+    Replicated mode: everything P() (one spec, prefix-matched). Sharded
+    mode: opt_state leaves are flat 1-D arrays laid out over the data axis
+    — P(DATA_AXIS) — while step/params/batch_stats stay replicated. The
+    returned TrainState-of-specs is a pytree prefix (each field's spec
+    broadcasts over that subtree), valid for shard_map in/out_specs and,
+    mapped through NamedSharding, for jit in/out_shardings.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dp.parallel.dist import DATA_AXIS
+
+    if update_sharding != "sharded":
+        return P()
+    return TrainState(step=P(), params=P(), opt_state=P(DATA_AXIS),
+                      batch_stats=P())
+
+
+def _state_shardings(mesh: Mesh, update_sharding: str):
+    """NamedSharding pytree-prefix for a TrainState (jit in/out_shardings):
+    the device-placement twin of `_state_specs`."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_dp.parallel.dist import DATA_AXIS
+
+    repl = replicated_sharding(mesh)
+    if update_sharding != "sharded":
+        return repl
+    return TrainState(
+        step=repl, params=repl,
+        opt_state=NamedSharding(mesh, P(DATA_AXIS)),
+        batch_stats=repl,
     )
 
 
@@ -484,6 +636,8 @@ def make_local_step(
     world: int = 1,
     axis_name: str | None = None,
     cast_params: bool = True,
+    update_sharding: str = "replicated",
+    collective_dtype: str | None = None,
 ) -> Callable:
     """The per-shard step program with *explicit* collectives, unjitted.
 
@@ -495,6 +649,19 @@ def make_local_step(
     psum(correct) over the ``data`` axis via the typed wrappers in
     `tpu_dp.parallel.collectives`, a line-for-line statement of what DDP's
     C++ reducer fires from backward hooks.
+
+    ``update_sharding="sharded"`` swaps the gradient pmean for the
+    cross-replica sharded weight update (Xu et al., PAPERS.md): the reduce
+    hook runs `collectives.psum_scatter` instead — each replica receives
+    only the mean of its 1/world flat shard of every gradient leaf — and
+    ``optimizer`` must be a `train.optim.ShardedUpdate`, whose update slices
+    the matching parameter shards locally, steps 1/world of the state, and
+    all-gathers the updated params. Same one-reduction-per-update invariant
+    (`reduce_scatter` counts as the data-axis reduction for DP201/DP202);
+    the compiled schedule becomes one reduce-scatter group + one all-gather
+    group instead of one all-reduce group (DP301's second legal schedule).
+    ``collective_dtype`` (e.g. "bf16") compresses the reduce-scatter wire
+    format, EQuARX-style — off (None/"") reduces in the leaf dtype.
 
     Exposed as a factory (rather than a closure inside the shard_map
     wrapper) so `tpu_dp.analysis` can trace the *real shipped program* on
@@ -512,12 +679,28 @@ def make_local_step(
 
     if axis_name is None:
         axis_name = DATA_AXIS
+    _check_update_sharding(update_sharding, optimizer)
+    wire_dtype = _parse_collective_dtype(collective_dtype)
+    if wire_dtype is not None and update_sharding != "sharded":
+        # Only the sharded reduce-scatter reads the wire dtype; accepting
+        # it here would silently run full-precision pmean instead.
+        raise ValueError(
+            "collective_dtype applies to the sharded update's "
+            "reduce-scatter; pass update_sharding='sharded'"
+        )
     loss_impl = _select_loss_impl(use_pallas_xent)
 
     def reduce_fn(grads, loss, correct, count, batch_stats):
-        # The explicit DDP all-reduce: grad mean over the data axis,
-        # exactly once, after any gradient-accumulation scan.
-        grads = collectives.pmean(grads, axis_name)
+        # The explicit DDP reduction: grad mean over the data axis, exactly
+        # once, after any gradient-accumulation scan. Replicated mode
+        # all-reduces the full leaves; sharded mode reduce-scatters, each
+        # replica keeping only the shard its optimizer slice will consume.
+        if update_sharding == "sharded":
+            grads = collectives.psum_scatter(
+                grads, axis_name, world=world, mean=True, dtype=wire_dtype
+            )
+        else:
+            grads = collectives.pmean(grads, axis_name)
         loss = collectives.pmean(loss, axis_name)
         correct = collectives.psum(correct, axis_name)
         count = count * world
@@ -550,6 +733,8 @@ def make_train_step_shard_map(
     use_pallas_xent: bool = False,
     accum_steps: int = 1,
     augment_fn: Callable | None = None,
+    update_sharding: str = "replicated",
+    collective_dtype: str | None = None,
 ) -> Callable:
     """Explicit-collectives variant of the DP train step (`shard_map`).
 
@@ -566,16 +751,26 @@ def make_train_step_shard_map(
     accumulation: batch leaves gain a leading replicated (accum_steps,)
     axis, the microbatch dim is the sharded one.
 
+    ``update_sharding="sharded"`` is that extension point exercised: the
+    gradient pmean becomes reduce-scatter → 1/world optimizer update →
+    params all-gather (`make_local_step` docs; Xu et al., PAPERS.md), with
+    ``optimizer`` a `train.optim.ShardedUpdate` and the TrainState's
+    opt_state living sharded over ``data`` (in/out specs P(DATA_AXIS) —
+    per-replica optimizer memory ~1/world). ``collective_dtype="bf16"``
+    additionally compresses the reduce-scatter wire format (EQuARX-style).
+
     BatchNorm models must be constructed with ``axis_name=DATA_AXIS`` so
     batch statistics sync across shards (the `shard_map` analogue of the
     global-batch stats GSPMD computes automatically — sync-BN semantics).
     """
     from jax.sharding import PartitionSpec as P
 
-    from tpu_dp.parallel.dist import DATA_AXIS
+    from tpu_dp.parallel.dist import DATA_AXIS, data_axis_size
 
     repl = replicated_sharding(mesh)
     repl_spec = P()
+    state_spec = _state_specs(update_sharding)
+    state_sh = _state_shardings(mesh, update_sharding)
     if accum_steps == 1:
         batch_sh = batch_sharding(mesh)
         batch_spec = P(DATA_AXIS)
@@ -586,7 +781,8 @@ def make_train_step_shard_map(
     local_step = make_local_step(
         model, optimizer, schedule, use_pallas_xent=use_pallas_xent,
         accum_steps=accum_steps, augment_fn=augment_fn,
-        world=int(mesh.devices.size), axis_name=DATA_AXIS,
+        world=data_axis_size(mesh), axis_name=DATA_AXIS,
+        update_sharding=update_sharding, collective_dtype=collective_dtype,
     )
 
     # Replication checking stays ON: an output that is rank-varying (a
@@ -595,19 +791,25 @@ def make_train_step_shard_map(
     sharded = _shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(repl_spec, batch_spec),
-        out_specs=(repl_spec, repl_spec),
+        in_specs=(state_spec, batch_spec),
+        out_specs=(state_spec, repl_spec),
     )
     return jax.jit(
         sharded,
-        in_shardings=(repl, batch_sh),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sh, batch_sh),
+        out_shardings=(state_sh, repl),
         donate_argnums=(0,),
     )
 
 
-def make_eval_step(model, mesh: Mesh) -> Callable:
+def make_eval_step(model, mesh: Mesh,
+                   update_sharding: str = "replicated") -> Callable:
     """Build the jitted eval step: global (correct, count) per batch.
+
+    ``update_sharding`` must match the TrainState's layout: with the
+    sharded weight update the opt_state leaves arrive sharded over ``data``
+    (the eval computation never touches them, but jit checks every input's
+    declared sharding against the committed buffers).
 
     Parity with the reference's synced eval
     (`cifar_example_ddp.py:124-136`): torchmetrics allreduces
@@ -621,6 +823,7 @@ def make_eval_step(model, mesh: Mesh) -> Callable:
     """
     repl = replicated_sharding(mesh)
     batch_sh = batch_sharding(mesh)
+    state_sh = _state_shardings(mesh, update_sharding)
 
     def step(state: TrainState, batch):
         images, labels = _maybe_normalize(batch["image"]), batch["label"]
@@ -641,6 +844,6 @@ def make_eval_step(model, mesh: Mesh) -> Callable:
 
     return jax.jit(
         step,
-        in_shardings=(repl, batch_sh),
+        in_shardings=(state_sh, batch_sh),
         out_shardings=repl,
     )
