@@ -420,6 +420,45 @@ def measure_point(cfg: dict) -> dict:
         elapsed = time.perf_counter() - t0
         n_steps_timed = measure_steps
 
+    # Per-step latency percentiles (tpu_dp.obs.spans): the headline number
+    # above is a MEAN over an unfenced back-to-back run — a tail regression
+    # (one slow step in 20: a recompile, an allocator stall, a relay
+    # hiccup) hides inside it. This pass dispatches with a fence per
+    # dispatch and rolls up p50/p95/p99, so BENCH_r*.json can tell a tail
+    # regression from a mean regression. Windowed points fence per window
+    # and attribute evenly (per-step tails inside one compiled scan are
+    # not host-observable); the fence cost makes these latency numbers —
+    # the throughput headline stays the unfenced measurement.
+    latency_rec = None
+    lat_steps = int(cfg.get("latency_steps", 20))
+    if lat_steps > 0:
+        from tpu_dp.obs.spans import SpanRecorder
+
+        rec = SpanRecorder(capacity=max(16, lat_steps * 2))
+        if window > 1:
+            exe, fence = loop_exe, lambda m: float(m["loss"][-1])
+        else:
+            exe, fence = step_exe, lambda m: float(m["loss"])
+        dispatches = max(2, -(-lat_steps // window)) if window > 1 else lat_steps
+        step_i = 0
+        for i in range(dispatches):
+            t0 = time.perf_counter()
+            if window > 1:
+                state, m = exe(state, pool)
+            else:
+                state, m = exe(state, batches[i % len(batches)])
+            fence(m)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            rec.record_window(step_i, max(1, window), {"step": dt_ms})
+            step_i += max(1, window)
+        roll = rec.rollup()["step"]
+        latency_rec = {
+            "p50_ms": roll["p50"], "p95_ms": roll["p95"],
+            "p99_ms": roll["p99"], "mean_ms": roll["mean"],
+            "max_ms": roll["max"], "n_steps": roll["n"],
+            "fence": "per_dispatch", "window": window,
+        }
+
     snap_every = int(cfg.get("snapshot_every", 0))
     snapshot_rec = None
     if snap_every > 0:
@@ -509,6 +548,8 @@ def measure_point(cfg: dict) -> dict:
                 "update_sharding": update_sharding,
             },
         }
+        if latency_rec is not None:
+            rec["latency"] = latency_rec
         if snapshot_rec is not None:
             rec["snapshot"] = snapshot_rec
         return rec
@@ -650,6 +691,12 @@ def main() -> None:
                          "params+momentum per chip, all-gathers updated "
                          "params (docs/PERF.md); recorded in the BENCH "
                          "json config block")
+    ap.add_argument("--latency-steps", type=int, default=20,
+                    help="fenced per-step latency sample size for the "
+                         "p50/p95/p99 'latency' block (tpu_dp.obs.spans; "
+                         "0 disables). Fenced per dispatch — these are "
+                         "latency numbers, the headline mean stays the "
+                         "unfenced throughput measurement")
     ap.add_argument("--snapshot-every", type=int, default=0,
                     help="also measure async-snapshot overhead at this step "
                          "cadence (tpu_dp.resilience.SnapshotManager; the "
@@ -708,6 +755,7 @@ def main() -> None:
             "model": args.model, "fused_stages": args.fused_stages,
             "fused_block_b": args.fused_block_b, "fused_bwd": args.fused_bwd,
             "snapshot_every": args.snapshot_every,
+            "latency_steps": args.latency_steps,
             "update_sharding": args.update_sharding}
     if args.sweep:
         grid = [

@@ -270,6 +270,29 @@ def test_probe_schedule_exponential_backoff():
     assert bench.probe_schedule(1, 75.0, 15.0) == [(0.0, 75.0)]
 
 
+def test_latency_steps_recorded_in_grid(monkeypatch, capsys):
+    """--latency-steps flows into every grid point's config, so
+    measure_point runs the fenced per-step latency pass (the 'latency'
+    p50/p95/p99 block that distinguishes tail from mean regressions —
+    docs/OBSERVABILITY.md)."""
+    grids = []
+
+    def fake_run_point(cfg, timeout_s):
+        grids.append(cfg)
+        return {"value": 1.0, "unit": bench.UNIT, "vs_baseline": 0.0,
+                "metric": bench.METRIC, "config": cfg}
+
+    monkeypatch.setattr(bench, "probe_device", lambda *a, **k: (
+        {"n_devices": 1, "device_kind": "x", "backend": "tpu"}, None))
+    monkeypatch.setattr(bench, "run_point", fake_run_point)
+    monkeypatch.setattr(bench, "archive", lambda r: None)
+    monkeypatch.setattr(bench.sys, "argv",
+                        ["bench.py", "--latency-steps", "7"])
+    bench.main()
+    capsys.readouterr()
+    assert grids and all(g["latency_steps"] == 7 for g in grids)
+
+
 def test_update_sharding_recorded_in_grid(monkeypatch, capsys):
     """--update-sharding flows into every grid point's config (and from
     there into the BENCH json config block via measure_point)."""

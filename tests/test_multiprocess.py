@@ -437,6 +437,71 @@ def test_two_process_resident_feed(tmp_path):
         results[1]["res_digest"], rel=1e-6)
 
 
+_HEALTH_WORKER = r"""
+import sys, time
+rank = int(sys.argv[1]); world = int(sys.argv[2]); run_dir = sys.argv[3]
+from tpu_dp.obs.health import HeartbeatWriter
+from tpu_dp.resilience.faultinject import FaultInjector
+
+inj = FaultInjector.from_spec("", rank=rank)  # plan from TPU_DP_FAULT env
+with HeartbeatWriter(run_dir, rank=rank) as hb:
+    for step in range(1, 7):
+        t0 = time.perf_counter()
+        time.sleep(0.03)           # uniform simulated step work
+        if inj is not None:
+            inj.on_step(step)      # the injected straggler delay
+        hb.beat(step, (time.perf_counter() - t0) * 1e3)
+print("HEALTH_OK", rank, flush=True)
+"""
+
+
+@pytest.mark.obs
+def test_two_process_straggler_and_hang_detection(tmp_path, monkeypatch):
+    """Cross-rank straggler attribution over a real process boundary: two
+    OS processes heartbeat into a shared run dir; the deterministic fault
+    injector (`TPU_DP_FAULT` delay, the same spec production uses) slows
+    rank 1 at step 3 only. The monitor must name exactly that rank and
+    step with the measured lag factor — and a stale-heartbeat check on the
+    same files must flag a hang per the configured ``on_flag``."""
+    import time
+
+    from tpu_dp.obs.health import HealthError, HealthMonitor
+
+    monkeypatch.setenv("TPU_DP_FAULT", "delay:step=3,rank=1,ms=300")
+    run_dir = tmp_path / "obs"
+    logs = _spawn_workers(
+        tmp_path, _HEALTH_WORKER,
+        [(rank, 2, run_dir) for rank in range(2)],
+        name="health_mp", timeout=120,
+    )
+    assert all("HEALTH_OK" in log for log in logs)
+
+    mon = HealthMonitor(run_dir, world=2, straggler_factor=3.0,
+                        stale_after_s=3600.0)
+    stragglers = [i for i in mon.scan() if i.kind == "straggler"]
+    assert stragglers, "injected delay not flagged"
+    # The worst offender is the injected-delay rank at the injected step.
+    worst = max(stragglers, key=lambda i: i.ratio)
+    assert (worst.rank, worst.step) == (1, 3)
+    assert worst.ratio >= 3.0          # the measured lag factor
+    assert worst.step_ms >= 300.0      # carries the delay
+    # Latest beats are healthy — the live check stays quiet…
+    assert mon.check(now=time.time()) == []
+
+    # …until the heartbeats go stale (simulated hang): warn mode reports,
+    # raise mode aborts with the flagged ranks attached.
+    later = time.time() + 10.0
+    lax = HealthMonitor(run_dir, world=2, stale_after_s=5.0,
+                        logger=(logged := []).append)
+    issues = lax.report(lax.check(now=later))
+    assert {i.rank for i in issues} == {0, 1}
+    assert all(i.kind == "stale" for i in issues) and len(logged) == 2
+    strict = HealthMonitor(run_dir, world=2, stale_after_s=5.0,
+                           on_flag="raise")
+    with pytest.raises(HealthError):
+        strict.report(strict.check(now=later))
+
+
 @pytest.mark.slow
 def test_two_process_fused_conv_step(tmp_path):
     """The fused Pallas-conv model under a true multi-process mesh: the

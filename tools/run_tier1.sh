@@ -16,6 +16,10 @@
 #                                    # finding. Emits artifacts/
 #                                    # dplint_report.json and artifacts/
 #                                    # collective_fingerprint.json.
+#   tools/run_tier1.sh --obs         # telemetry lane: a 10-step obs=full
+#                                    # smoke run (archives its metrics.jsonl
+#                                    # and Perfetto trace under artifacts/)
+#                                    # + the -m obs tests.
 #
 # Exit code is pytest's; the DOTS_PASSED line echoes the pass count the
 # roadmap tracks across PRs.
@@ -48,6 +52,26 @@ if [ "${1:-}" = "--dplint" ]; then
         exit "$rc"
     fi
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m analysis \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--obs" ]; then
+    # 10-step smoke at obs=full on the CPU backend: proves the full
+    # telemetry path end to end (per-step schema-2 records, heartbeats,
+    # Perfetto export) and archives the artifacts CI reviewers diff.
+    mkdir -p artifacts
+    SMOKE=$(mktemp -d /tmp/tpu_dp_obs_smoke.XXXXXX) || exit 1
+    env JAX_PLATFORMS=cpu python train.py \
+        --data.dataset=synthetic --data.synthetic_train_size=40 \
+        --data.synthetic_test_size=16 --data.batch_size=4 \
+        --train.epochs=1 --train.log_every=5 --train.eval_at_end=false \
+        --train.obs=full --train.ckpt_dir="$SMOKE/ck" || exit $?
+    cp "$SMOKE/ck/metrics.jsonl" artifacts/metrics.jsonl || exit 1
+    cp "$SMOKE/ck/obs/trace.perfetto.json" artifacts/trace.perfetto.json \
+        || exit 1
+    rm -rf "$SMOKE"
+    echo "obs smoke: artifacts/metrics.jsonl + artifacts/trace.perfetto.json"
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs \
         -p no:cacheprovider
 fi
 

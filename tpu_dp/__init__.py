@@ -16,6 +16,7 @@ from tpu_dp import (
     data,
     metrics,
     models,
+    obs,
     ops,
     parallel,
     resilience,
@@ -44,6 +45,7 @@ __all__ = [
     "load_checkpoint",
     "metrics",
     "models",
+    "obs",
     "ops",
     "parallel",
     "resilience",

@@ -164,6 +164,12 @@ class RecompileGuard:
             grew = size - self._baseline
             self._baseline = size
             self.retraces += grew
+            # Telemetry (tpu_dp.obs): retraces land in the process-wide
+            # registry so metrics.jsonl records carry the recompile count
+            # next to the step-time spans that pay for it.
+            from tpu_dp.obs.counters import counters
+
+            counters.inc("recompile.retraces", grew)
             msg = (
                 f"RecompileGuard({self.name}): {grew} retrace(s) after "
                 f"warmup (call {self.calls}, trace cache now {size}) — an "

@@ -108,6 +108,49 @@ class TrainConfig:
     # reduce in the leaf dtype; "bf16" halves the bytes on the wire at
     # bf16 rounding cost — the EQuARX-style compressed-collective knob).
     collective_dtype: str = ""
+    # Runtime telemetry (tpu_dp/obs/, docs/OBSERVABILITY.md). "off": the
+    # hot loop is exactly the untelemetered path (benched within noise,
+    # HLO identical). "basic": per-step data_wait/dispatch spans, counter
+    # snapshots at log boundaries, cross-rank heartbeats — no added host
+    # syncs. "full": adds the h2d and fence-to-fence device spans (one
+    # device→host scalar fetch per window — honest per-step latency at a
+    # measured pipelining cost) and per-step metrics.jsonl records.
+    obs: str = "off"  # off | basic | full
+    # metrics.jsonl sink ("" = <train.ckpt_dir>/metrics.jsonl).
+    metrics_path: str = ""
+    # Step-ranged profiling: "START:END" global steps traced to
+    # train.profile_dir (which must be set) instead of the whole run.
+    profile_steps: str = ""
+
+
+@dataclass
+class ObsConfig:
+    """Telemetry tuning (tpu_dp/obs/; enabled by ``train.obs``)."""
+
+    # Shared telemetry dir ("" = <train.ckpt_dir>/obs): heartbeat files
+    # land here (every rank writes its own; multi-host needs this on a
+    # shared filesystem for cross-host aggregation) and the Perfetto
+    # export defaults into it.
+    run_dir: str = ""
+    # Span ring-buffer length (per-step records kept for rollups/export).
+    span_capacity: int = 4096
+    # Heartbeat cadence in optimizer steps (crossing discipline, like
+    # snapshots); 0 disables heartbeats while keeping spans/counters.
+    heartbeat_every_steps: int = 1
+    # Straggler threshold: flagged when a rank's step time exceeds this
+    # factor x the cross-rank median at the same observation.
+    straggler_factor: float = 3.0
+    # Hang threshold: a heartbeat older than this is a stale/hung rank.
+    stale_after_s: float = 60.0
+    # Median floor (ms) for the straggler ratio denominator — µs-scale
+    # smoke steps jitter past any factor; below this nothing is flagged.
+    min_step_ms: float = 1.0
+    # What rank 0 does when the monitor flags an issue: warn logs (and
+    # keeps training), raise aborts — the CI / supervised-fleet mode.
+    on_straggler: str = "warn"  # warn | raise
+    # Perfetto trace output ("" = <run_dir>/trace.perfetto.json), written
+    # by rank 0 at the end of fit().
+    perfetto_path: str = ""
 
 
 @dataclass
@@ -145,6 +188,7 @@ class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     def override(self, dotted: str, value: str) -> None:
         """Apply one ``section.field=value`` override, coercing to field type."""

@@ -1,0 +1,54 @@
+"""tpu_dp.obs — unified runtime telemetry (docs/OBSERVABILITY.md).
+
+Four pieces, all host-side and all config-gated by ``train.obs``:
+
+- `spans`    — per-step span recording (data_wait / h2d / dispatch /
+  device) in a ring buffer with p50/p95/p99 rollups;
+- `counters` — the process-wide counter/gauge registry the existing
+  subsystems (resilience retries, snapshots, RecompileGuard, preemption)
+  publish into unconditionally;
+- `health`   — file-based cross-rank heartbeats, straggler attribution
+  and hang detection;
+- `export`   — Perfetto / Chrome-trace JSON so a run renders in
+  chrome://tracing without TensorBoard.
+
+The package imports no jax at module load (the device-memory gauges load
+it lazily): heartbeat monitors and trace tooling must work in watcher
+processes with no accelerator attached.
+"""
+
+from tpu_dp.obs.counters import (
+    Counters,
+    counters,
+    update_device_memory_gauges,
+)
+from tpu_dp.obs.export import (
+    export_perfetto,
+    merge_traces,
+    to_trace_events,
+    validate_trace,
+)
+from tpu_dp.obs.health import (
+    HealthError,
+    HealthIssue,
+    HealthMonitor,
+    HeartbeatWriter,
+)
+from tpu_dp.obs.spans import STEP_SPANS, SpanRecorder, percentile
+
+__all__ = [
+    "Counters",
+    "HealthError",
+    "HealthIssue",
+    "HealthMonitor",
+    "HeartbeatWriter",
+    "STEP_SPANS",
+    "SpanRecorder",
+    "counters",
+    "export_perfetto",
+    "merge_traces",
+    "percentile",
+    "to_trace_events",
+    "update_device_memory_gauges",
+    "validate_trace",
+]

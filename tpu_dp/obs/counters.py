@@ -1,0 +1,119 @@
+"""Process-wide counter/gauge registry — the numbers every subsystem emits.
+
+The stack already *generates* operational signals nobody collects: retry
+attempts (`resilience/retry.py`), silent-recompile retraces
+(`analysis/recompile.py`), snapshot write/wait seconds
+(`resilience/snapshot.py`), preemption signals (`resilience/preempt.py`).
+This module is the single sink those subsystems publish into, and the
+single source the trainer snapshots into `metrics.jsonl` and the Perfetto
+export (docs/OBSERVABILITY.md "Counter registry").
+
+Design constraints, in order:
+
+- **Signal-safe**: `PreemptionHandler._handle` increments from a signal
+  handler, where taking a `threading.Lock` the interrupted main thread
+  might hold would deadlock the process at the worst possible moment.
+  `inc`/`gauge` therefore use plain dict ops under the GIL — a concurrent
+  read-modify-write can lose an increment, which is an acceptable
+  telemetry error and the price of never deadlocking.
+- **Import-light**: imported by `resilience/*` and `analysis/recompile.py`
+  at module load; must not import jax (the device-memory gauges import it
+  lazily) or anything from `tpu_dp`.
+- **Always-on**: publishing is unconditional (an `inc` is one dict write;
+  gating every call site on `train.obs` would couple four subsystems to
+  the trainer's config). What the *trainer* does with the registry —
+  snapshot it into records, or ignore it — is what `train.obs` gates.
+
+Names are dotted, `subsystem.metric[_unit]`: `retry.attempts`,
+`snapshot.write_s`, `recompile.retraces`, `device.mem_in_use_bytes`.
+Counters accumulate; gauges hold the last written value.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class Counters:
+    """A flat registry of monotonic counters and last-value gauges."""
+
+    def __init__(self):
+        self._counts: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        """Add ``value`` to counter ``name`` (creating it at 0).
+
+        Lock-free on purpose — see the module docstring; safe to call from
+        signal handlers and background writer threads.
+        """
+        self._counts[name] = self._counts.get(name, 0.0) + value
+
+    def gauge(self, name: str, value: float) -> None:
+        """Set gauge ``name`` to ``value`` (last write wins)."""
+        self._gauges[name] = float(value)
+
+    def get(self, name: str, default: float = 0.0) -> float:
+        if name in self._counts:
+            return self._counts[name]
+        return self._gauges.get(name, default)
+
+    def snapshot(self) -> dict[str, float]:
+        """One flat point-in-time dict of every counter and gauge.
+
+        Values are rounded to 6 decimals — these land in JSON records, and
+        15-digit float seconds are noise there.
+        """
+        out = {}
+        for src in (self._counts, self._gauges):
+            for k, v in list(src.items()):
+                out[k] = round(v, 6)
+        return out
+
+    def reset(self) -> None:
+        """Drop everything — test isolation only."""
+        self._counts.clear()
+        self._gauges.clear()
+
+
+#: The process-wide registry every subsystem publishes into.
+counters = Counters()
+
+
+def update_device_memory_gauges(registry: Counters | None = None) -> dict[str, float]:
+    """Publish per-device HBM gauges from `jax.local_devices()[i].memory_stats()`.
+
+    Gauges: ``device.mem_in_use_bytes.<i>`` and ``device.mem_limit_bytes.<i>``
+    per local device, plus the cross-device max ``device.mem_in_use_bytes``.
+    Backends without memory stats (CPU, some PJRT plugins return None or
+    raise) publish nothing — absence of the gauge means "not measured",
+    never a fake zero. Returns the gauges written (for tests/logging).
+    """
+    reg = counters if registry is None else registry
+    import jax  # lazy: keep this module importable without a backend
+
+    written: dict[str, float] = {}
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return written
+    in_use_max = None
+    for i, dev in enumerate(devices):
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        in_use = stats.get("bytes_in_use")
+        limit = stats.get("bytes_limit")
+        if in_use is not None:
+            written[f"device.mem_in_use_bytes.{i}"] = float(in_use)
+            in_use_max = max(in_use_max or 0.0, float(in_use))
+        if limit is not None:
+            written[f"device.mem_limit_bytes.{i}"] = float(limit)
+    if in_use_max is not None:
+        written["device.mem_in_use_bytes"] = in_use_max
+    for name, value in written.items():
+        reg.gauge(name, value)
+    return written

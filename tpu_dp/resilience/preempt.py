@@ -29,6 +29,7 @@ from pathlib import Path
 from typing import Any
 
 from tpu_dp import checkpoint as ckpt_lib
+from tpu_dp.obs.counters import counters as _counters
 
 logger = logging.getLogger(__name__)
 
@@ -68,6 +69,10 @@ class PreemptionHandler:
     def _handle(self, signum, frame):
         self.last_signal = signum
         self._event.set()
+        # Telemetry: `Counters.inc` is lock-free by design (and imported at
+        # module scope — no import-lock in signal context), so publishing
+        # from a handler cannot deadlock (tpu_dp/obs/counters.py).
+        _counters.inc("preempt.signals")
         logger.warning(
             "preemption signal %s received — snapshotting at the next step "
             "boundary, then exiting %d",

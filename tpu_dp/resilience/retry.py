@@ -26,6 +26,8 @@ import logging
 import time
 from typing import Any, Callable, Sequence
 
+from tpu_dp.obs.counters import counters as _counters
+
 logger = logging.getLogger(__name__)
 
 
@@ -77,6 +79,10 @@ def retry_call(
     delays = backoff_delays(retries, base_delay, max_delay)
     last: BaseException | None = None
     for attempt in range(retries + 1):
+        # Telemetry (tpu_dp.obs): every attempt counted; the split between
+        # `retry.attempts` and `retry.retries` is what distinguishes "lots
+        # of calls" from "calls that keep failing" in metrics.jsonl.
+        _counters.inc("retry.attempts")
         try:
             return fn(*args, **kwargs)
         except PeerFailedError:
@@ -85,11 +91,13 @@ def retry_call(
             last = e
             if attempt == retries:
                 break
+            _counters.inc("retry.retries")
             logger.warning(
                 "%s failed (attempt %d/%d): %s — retrying in %.3fs",
                 name, attempt + 1, retries + 1, e, delays[attempt],
             )
             sleep(delays[attempt])
+    _counters.inc("retry.exhausted")
     raise last  # type: ignore[misc]
 
 

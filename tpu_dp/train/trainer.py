@@ -36,9 +36,26 @@ from tpu_dp.models import build_model
 from tpu_dp.parallel import dist
 from tpu_dp.train.optim import SGD
 from tpu_dp.train.schedule import make_schedule
+from tpu_dp.obs.counters import counters as _obs_counters
 from tpu_dp.train.state import create_train_state
 from tpu_dp.train.step import make_eval_step, make_train_step
-from tpu_dp.utils import ThroughputMeter, log0, print0, profile_trace
+from tpu_dp.utils import (
+    StepProfiler,
+    ThroughputMeter,
+    log0,
+    parse_profile_steps,
+    print0,
+    profile_trace,
+)
+
+
+def _iso_ts(epoch_seconds: float) -> str:
+    """ISO-8601 UTC stamp for metrics records (millisecond resolution)."""
+    from datetime import datetime, timezone
+
+    return datetime.fromtimestamp(
+        epoch_seconds, timezone.utc
+    ).isoformat(timespec="milliseconds")
 
 
 class Trainer:
@@ -300,6 +317,50 @@ class Trainer:
         # Host-side mirror of state.step: the snapshot cadence and fault
         # steps key off it without a per-window device sync.
         self._host_step = int(self.state.step)
+        self._metrics_file = None  # lazily opened by _log_metrics (rank 0)
+        self._hb_write_failed = False  # one-shot heartbeat-failure warning
+
+        # Telemetry (tpu_dp/obs/, docs/OBSERVABILITY.md). Everything below
+        # is None at obs=off — the hot loop then takes the untelemetered
+        # path (one is-None check per window; benched within noise).
+        if cfg.train.obs not in ("off", "basic", "full"):
+            raise ValueError(
+                f"train.obs must be off|basic|full, got {cfg.train.obs!r}"
+            )
+        self.obs_mode = cfg.train.obs
+        self.obs_dir = Path(
+            cfg.obs.run_dir or Path(cfg.train.ckpt_dir) / "obs"
+        )
+        self.spans = None
+        self.heartbeat = None
+        self.health = None
+        if self.obs_mode != "off":
+            from tpu_dp.obs import HealthMonitor, HeartbeatWriter, SpanRecorder
+
+            self.spans = SpanRecorder(capacity=cfg.obs.span_capacity)
+            if cfg.obs.heartbeat_every_steps > 0:
+                # Every rank appends to its own heartbeat file — per-rank
+                # host IO is the protocol, not a rank gate.
+                self.heartbeat = HeartbeatWriter(
+                    self.obs_dir, rank=self.ctx.process_index,
+                    every_steps=cfg.obs.heartbeat_every_steps,
+                )
+            if self.heartbeat is not None and self.ctx.process_index == 0:  # dplint: allow(DP101) host-only monitor
+                self.health = HealthMonitor(
+                    self.obs_dir, world=self.ctx.process_count,
+                    straggler_factor=cfg.obs.straggler_factor,
+                    stale_after_s=cfg.obs.stale_after_s,
+                    min_step_ms=cfg.obs.min_step_ms,
+                    on_flag=cfg.obs.on_straggler,
+                )
+        # Step-ranged profiling (train.profile_steps=START:END): trace only
+        # the window under investigation instead of the whole run.
+        profile_range = parse_profile_steps(cfg.train.profile_steps)
+        self._step_profiler = None
+        if profile_range is not None:
+            self._step_profiler = StepProfiler(
+                cfg.train.profile_dir, *profile_range
+            )
 
         if cfg.train.verify_fingerprint:
             self._verify_step_fingerprint()
@@ -519,7 +580,40 @@ class Trainer:
                 {k: v[j] for k, v in stacked.items()} for j in range(n)
             )
 
-        for n, item in items:
+        # Telemetry (train.obs != off): span timestamps bracket the loop's
+        # phases — t0→t1 data_wait, t1→t2 h2d (full only: block on the
+        # placed batch), t2→t3 dispatch, t3→t4 device (full only: a scalar
+        # fetch, the `ThroughputMeter.mark()` fence discipline — the only
+        # obs mode that adds a host sync, which is why it is opt-in).
+        spans = self.spans
+        obs_full = self.obs_mode == "full"
+        t_boundary = time.perf_counter()  # heartbeat boundary-to-boundary clock
+        hb_steps = 0  # steps since the last accepted heartbeat
+        it = iter(items)
+        while True:
+            if spans is not None:
+                # ts_wall is the step's wall-clock START — stamped before
+                # next(), so the data_wait slice occupies its real place
+                # on the exported timeline instead of shifting every
+                # step's slices right by its own data_wait.
+                ts_wall = time.time()
+                t0 = time.perf_counter()
+            try:
+                n, item = next(it)
+            except StopIteration:
+                break
+            if self._step_profiler is not None:
+                # BEFORE dispatch: the window about to run is steps
+                # [_host_step + 1, _host_step + n] — arming at the
+                # post-window boundary would trace the window after the
+                # requested range (and miss in-window ranges entirely).
+                self._step_profiler.on_window_start(self._host_step + 1, n)
+            if spans is not None:
+                t1 = time.perf_counter()
+                t2 = t1
+                if obs_full:
+                    jax.block_until_ready(item)
+                    t2 = time.perf_counter()
             if self.resident_train is not None:
                 # Indices in, stacked metrics out — the dataset never
                 # re-crosses the host→device link.
@@ -534,6 +628,45 @@ class Trainer:
                 # One dispatch, n optimizer steps (device-side scanned loop).
                 self.state, stacked = self.multi_step(self.state, item)
                 window = _unstack(stacked, n)
+            if spans is not None:
+                t3 = time.perf_counter()
+                t4 = t3
+                if obs_full:
+                    float(window[-1]["loss"])  # scalar fetch: honest fence
+                    t4 = self.meter.mark()     # one fence, two consumers
+                    _obs_counters.gauge(
+                        "throughput.images_per_sec",
+                        round(self.meter.images_per_sec, 1),
+                    )
+                    from tpu_dp.obs import update_device_memory_gauges
+
+                    update_device_memory_gauges()
+                # Basic mode OMITS h2d/device rather than recording 0.0:
+                # absence means "not measured" — a fake zero would render
+                # as "device took 0 ms" in rollups and the Perfetto trace
+                # (same principle as the absent memory gauges).
+                window_spans = {
+                    "data_wait": (t1 - t0) * 1e3,
+                    "dispatch": (t3 - t2) * 1e3,
+                }
+                if obs_full:
+                    window_spans["h2d"] = (t2 - t1) * 1e3
+                    window_spans["device"] = (t4 - t3) * 1e3
+                new_recs = spans.record_window(
+                    self._host_step + 1, n, window_spans, ts=ts_wall,
+                )
+                if obs_full:
+                    # Per-step metrics.jsonl records (schema 2): spans plus
+                    # a counter snapshot, one line per optimizer step.
+                    snap = _obs_counters.snapshot()
+                    for r in new_recs:
+                        self._log_metrics({
+                            "step": r["step"],
+                            "ts": _iso_ts(r["ts"]),
+                            "spans": {k: round(v, 3)
+                                      for k, v in r["spans"].items()},
+                            "counters": snap,
+                        })
             for m in window:
                 i += 1
                 # On-device async adds; no host sync inside the loop.
@@ -555,6 +688,12 @@ class Trainer:
                     print0("[%d, %5d] loss: %.3f"
                            % (epoch + 1, i + 1, float(run_loss) / run_steps))
                     run_loss, run_steps = None, 0
+                    if self.health is not None:
+                        # Rank 0 reads every rank's heartbeat file at the
+                        # log cadence (already a sync boundary): stragglers
+                        # and stale/hung ranks get named while the run is
+                        # still up, not in the postmortem.
+                        self.health.report(self.health.check())
             # Resilience hooks, once per dispatched window (the host-side
             # step boundary): async snapshot on cadence, then fault
             # injection (tests), then the preemption flag check.
@@ -568,6 +707,33 @@ class Trainer:
                 )
             if self.fault is not None:
                 self.fault.on_step(self._host_step)
+            if self.heartbeat is not None:
+                # Boundary-to-boundary wall time per step since the last
+                # accepted beat, AFTER the fault hook so an injected delay
+                # is attributed to the step it fired at. Host-clock
+                # honesty: without fences (basic mode) this is a dispatch
+                # rate; sustained, backpressure makes it track the device
+                # rate.
+                now = time.perf_counter()
+                hb_steps += n
+                try:
+                    accepted = self.heartbeat.beat(
+                        self._host_step, (now - t_boundary) / hb_steps * 1e3
+                    )
+                except OSError:
+                    # Best-effort telemetry on a shared filesystem where
+                    # transient errors (NFS blip, quota) are routine — a
+                    # failed beat must never abort training. Logged once;
+                    # the monitor sees the gap as staleness.
+                    if not self._hb_write_failed:
+                        self._hb_write_failed = True
+                        log0("heartbeat write failed (suppressing further "
+                             "warnings)", exc_info=True)
+                    accepted = False
+                if accepted:
+                    t_boundary, hb_steps = now, 0
+            if self._step_profiler is not None:
+                self._step_profiler.on_step(self._host_step)
             if self.preempt is not None and self.preempt.requested:
                 self._preempt_exit(epoch, done)
         stats = {
@@ -623,18 +789,42 @@ class Trainer:
             f"{self.snapshot_dir}"
         )
 
+    @property
+    def metrics_path(self) -> Path:
+        """The metrics.jsonl sink (train.metrics_path, defaulting to the
+        historical <ckpt_dir>/metrics.jsonl)."""
+        return Path(
+            self.cfg.train.metrics_path
+            or Path(self.cfg.train.ckpt_dir) / "metrics.jsonl"
+        )
+
     def _log_metrics(self, record: dict) -> None:
-        """Append a JSON line to <ckpt_dir>/metrics.jsonl (process 0 only).
+        """Append a schema-2 JSON line to the metrics sink (process 0 only).
 
         Structured observability the reference lacks (its only records are
-        stdout prints, SURVEY.md §5 "Metrics / logging").
+        stdout prints, SURVEY.md §5 "Metrics / logging"). Every record is
+        stamped with a wall-clock ``ts`` (ISO-8601 UTC), the global
+        optimizer ``step``, and ``schema: 2`` — the previous schema's
+        records (implicitly v1) carried none of the three, so two runs'
+        logs could not even be aligned in time. Caller-provided fields win
+        (per-step span records carry their own measured ts/step).
         """
         if self.ctx.process_index != 0:  # dplint: allow(DP101) host-only IO
             return
-        path = Path(self.cfg.train.ckpt_dir) / "metrics.jsonl"
-        path.parent.mkdir(parents=True, exist_ok=True)
-        with open(path, "a") as f:
-            f.write(json.dumps(record) + "\n")
+        rec = {"ts": _iso_ts(time.time()), "step": self._host_step,
+               "schema": 2}
+        rec.update(record)
+        if self._metrics_file is None or self._metrics_file.closed:
+            # Opened once and held (append + flush per record): obs=full
+            # writes one record per optimizer step, and a per-record
+            # open/close on a shared filesystem would land in the very
+            # step times being recorded. Closed in fit()'s finally;
+            # post-fit records (the eval line) transparently reopen.
+            path = self.metrics_path
+            path.parent.mkdir(parents=True, exist_ok=True)
+            self._metrics_file = open(path, "a")
+        self._metrics_file.write(json.dumps(rec) + "\n")
+        self._metrics_file.flush()
 
     def evaluate(self) -> dict[str, float]:
         """Global test accuracy/loss with ONE device→host fetch.
@@ -661,6 +851,44 @@ class Trainer:
         n = max(int(count), 1)
         return {"accuracy": float(correct) / n, "loss": float(loss_sum) / n}
 
+    def export_trace(self) -> Path | None:
+        """Write the Perfetto/Chrome trace JSON for this rank's spans.
+
+        Rank 0 only (one artifact per run dir; per-rank traces would need
+        per-rank paths — `obs.export.merge_traces` exists for offline
+        fan-in). Returns the path, or None when obs is off / not rank 0.
+        """
+        if self.spans is None:
+            return None
+        if self.ctx.process_index != 0:  # dplint: allow(DP101) host-only IO
+            return None
+        from tpu_dp.obs import export_perfetto
+
+        path = Path(
+            self.cfg.obs.perfetto_path
+            or self.obs_dir / "trace.perfetto.json"
+        )
+        out = export_perfetto(
+            path, self.spans.records(), rank=self.ctx.process_index,
+            counter_points=[
+                {"ts": time.time(), "counters": _obs_counters.snapshot()}
+            ],
+        )
+        log0("perfetto trace: %s (%d step records) — open in "
+             "chrome://tracing or ui.perfetto.dev", out, len(self.spans))
+        return out
+
+    def obs_summary(self) -> dict[str, Any] | None:
+        """Span rollup + counter snapshot for end-of-run summaries
+        (train.py's JSON line); None when obs is off."""
+        if self.spans is None:
+            return None
+        return {
+            "mode": self.obs_mode,
+            "spans_ms": self.spans.rollup(),
+            "counters": _obs_counters.snapshot(),
+        }
+
     def fit(self) -> dict[str, Any]:
         cfg = self.cfg
         log0(
@@ -675,7 +903,14 @@ class Trainer:
         try:
             if self.preempt is not None:
                 self.preempt.install()
-            with profile_trace(cfg.train.profile_dir):
+            # Step-ranged profiling replaces the whole-run trace: both at
+            # once would nest jax.profiler sessions (an error) and the
+            # ranged trace exists precisely to avoid the whole-run one.
+            whole_run_profile = (
+                None if self._step_profiler is not None
+                else cfg.train.profile_dir
+            )
+            with profile_trace(whole_run_profile):
                 for epoch in range(self.start_epoch, cfg.train.epochs):
                     start_step = (
                         self.start_step if epoch == self.start_epoch else 0
@@ -685,9 +920,23 @@ class Trainer:
                     log0("epoch %d: train loss %.4f acc %.4f (%.1f img/s)",
                          epoch + 1, stats["loss"], stats["accuracy"],
                          self.meter.images_per_sec)
-                    self._log_metrics({"epoch": epoch + 1, **stats,
-                                       "images_per_sec":
-                                           round(self.meter.images_per_sec, 1)})
+                    epoch_rec = {"epoch": epoch + 1, **stats,
+                                 "images_per_sec":
+                                     round(self.meter.images_per_sec, 1)}
+                    if self.spans is not None:
+                        # Epoch rollup: span percentiles over the ring +
+                        # the counter registry — the at-a-glance record
+                        # (per-step records are obs=full only).
+                        _obs_counters.gauge(
+                            "throughput.images_per_sec",
+                            round(self.meter.images_per_sec, 1),
+                        )
+                        from tpu_dp.obs import update_device_memory_gauges
+
+                        update_device_memory_gauges()
+                        epoch_rec["spans"] = self.spans.rollup()
+                        epoch_rec["counters"] = _obs_counters.snapshot()
+                    self._log_metrics(epoch_rec)
                     self.ckpt_mgr.save(
                         self.state,
                         {"epoch": epoch, "config": cfg.to_dict(),
@@ -698,6 +947,11 @@ class Trainer:
                         ev = self.evaluate()
                         log0("epoch %d: eval loss %.4f acc %.4f",
                              epoch + 1, ev["loss"], ev["accuracy"])
+                    if self.health is not None:
+                        # End-of-epoch health pass: a rank that went quiet
+                        # mid-epoch is flagged here even when log_every
+                        # never fired.
+                        self.health.report(self.health.check())
                     # A signal that lands between epochs (or during eval)
                     # still gets the snapshot-and-exit-143 contract.
                     if self.preempt is not None and self.preempt.requested:
@@ -728,6 +982,31 @@ class Trainer:
                      "exception propagates)", exc_info=True)
             if self.preempt is not None:
                 self.preempt.uninstall()
+            # Telemetry teardown runs on EVERY exit path: a crashed or
+            # preempted run is exactly when the trace matters. Each step
+            # is guarded separately — a failed profiler flush (disk full,
+            # deleted trace dir) must neither mask the original exception
+            # nor rob the Perfetto export behind it.
+            if self._step_profiler is not None:
+                try:
+                    self._step_profiler.close()
+                except Exception:
+                    log0("step-profiler close failed", exc_info=True)
+            if self.heartbeat is not None:
+                try:
+                    self.heartbeat.close()
+                except Exception:
+                    log0("heartbeat close failed", exc_info=True)
+            if self.spans is not None and len(self.spans):
+                try:
+                    self.export_trace()
+                except Exception:
+                    log0("perfetto export failed", exc_info=True)
+            if self._metrics_file is not None:
+                try:
+                    self._metrics_file.close()
+                except OSError:
+                    log0("metrics sink close failed", exc_info=True)
         print0("Finished Training")  # `cifar_example.py:90` parity
         wall = time.perf_counter() - t0
 

@@ -7,15 +7,21 @@ from tpu_dp.utils.determinism import (
 )
 from tpu_dp.utils.logging import get_logger, log0, print0
 from tpu_dp.utils.meter import ThroughputMeter
-from tpu_dp.utils.profiling import profile_trace
+from tpu_dp.utils.profiling import (
+    StepProfiler,
+    parse_profile_steps,
+    profile_trace,
+)
 
 __all__ = [
+    "StepProfiler",
     "ThroughputMeter",
     "check_cross_process_consistency",
     "check_replica_consistency",
     "get_logger",
     "local_digest",
     "log0",
+    "parse_profile_steps",
     "print0",
     "profile_trace",
 ]

@@ -24,8 +24,10 @@ class ThroughputMeter:
         self._start: float | None = None
         self._last: float | None = None
 
-    def step(self, batch_size: int) -> None:
-        """Call after each dispatched step."""
+    def step(self, batch_size: int) -> float:
+        """Call after each dispatched step; returns the dispatch timestamp
+        (`time.perf_counter` seconds — the span recorder's clock, so obs
+        code can share this stamp instead of reading the clock twice)."""
         now = time.perf_counter()
         self._steps += 1
         if self._steps == self.warmup_steps:
@@ -34,16 +36,22 @@ class ThroughputMeter:
         elif self._steps > self.warmup_steps:
             self._images += batch_size
         self._last = now
+        return now
 
-    def mark(self) -> None:
-        """Record 'now' as the end of measured work.
+    def mark(self) -> float:
+        """Record 'now' as the end of measured work; returns the fence
+        timestamp.
 
         Call after a true host↔device fence (e.g. fetching a metric scalar):
         step() timestamps dispatch, which runs ahead of device execution, so
         without a fence the rate would be a dispatch rate, not a throughput.
+        The returned stamp is the same fence time `tpu_dp.obs` uses as the
+        end of a step's ``device`` span — one fence, two consumers.
         """
+        now = time.perf_counter()
         if self._steps > self.warmup_steps:
-            self._last = time.perf_counter()
+            self._last = now
+        return now
 
     @property
     def measured_steps(self) -> int:

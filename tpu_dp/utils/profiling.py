@@ -1,12 +1,18 @@
 """Profiler hooks — `jax.profiler` traces viewable in TensorBoard/Perfetto.
 
 The reference has no profiler (SURVEY.md §5); this wraps the train loop in
-an XLA trace context when a trace dir is configured.
+an XLA trace context when a trace dir is configured (`train.profile_dir`),
+and — since whole-run traces of long jobs are gigabytes of mostly
+steady-state — adds *step-ranged* profiling (`train.profile_steps=
+START:END`, docs/OBSERVABILITY.md): the trace starts when the global step
+reaches START and stops at END, capturing exactly the window under
+investigation (e.g. the steps around a suspected recompile cliff).
 """
 
 from __future__ import annotations
 
 import contextlib
+from typing import Callable
 
 import jax
 
@@ -19,3 +25,99 @@ def profile_trace(trace_dir: str | None):
             yield
     else:
         yield
+
+
+def parse_profile_steps(spec: str | None) -> tuple[int, int] | None:
+    """``"START:END"`` → (start, end); empty/None → None.
+
+    Global optimizer steps, half-open [START, END): profiling starts at
+    the first host boundary where the step count reaches START and stops
+    at the first boundary ≥ END. Validated eagerly so a typo fails at
+    config time, not hours in at step START.
+    """
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    start_s, sep, end_s = spec.partition(":")
+    try:
+        if not sep:
+            raise ValueError
+        start, end = int(start_s), int(end_s)
+    except ValueError:
+        raise ValueError(
+            f"train.profile_steps must be START:END (global steps), "
+            f"got {spec!r}"
+        ) from None
+    if start < 0 or end <= start:
+        raise ValueError(
+            f"train.profile_steps needs 0 <= START < END, got {spec!r}"
+        )
+    return start, end
+
+
+class StepProfiler:
+    """Start/stop a `jax.profiler` trace over a global-step range.
+
+    Two trainer hooks bracket each dispatched window:
+    :meth:`on_window_start` (BEFORE dispatch, with the steps the window
+    is about to run) arms the trace as soon as a window overlaps
+    [start, end) — arming only after a window completes would trace the
+    window *after* the requested one, and a range that fits inside a
+    single window would be skipped entirely; :meth:`on_step` (after the
+    window, with the completed step count) stops it once step END-1 has
+    run. The profiler arms once (a second pass over the range after e.g.
+    a resume does not re-trace — one artifact per run). With windowed
+    dispatch the realized range snaps outward to window boundaries: the
+    host cannot start or stop a trace mid-scan.
+
+    ``start_fn``/``stop_fn`` are injectable for tests (the real profiler
+    is process-global state).
+    """
+
+    def __init__(
+        self,
+        trace_dir: str,
+        start_step: int,
+        end_step: int,
+        start_fn: Callable[[str], None] | None = None,
+        stop_fn: Callable[[], None] | None = None,
+    ):
+        if not trace_dir:
+            raise ValueError(
+                "profile_steps needs train.profile_dir for the trace output"
+            )
+        self.trace_dir = trace_dir
+        self.start_step = int(start_step)
+        self.end_step = int(end_step)
+        self._start = start_fn or jax.profiler.start_trace
+        self._stop = stop_fn or jax.profiler.stop_trace
+        self.active = False
+        self.done = False
+
+    def on_window_start(self, first_step: int, n_steps: int) -> None:
+        """About to dispatch steps [first_step, first_step + n_steps):
+        arm the trace if the window overlaps the requested range."""
+        if self.done or self.active:
+            return
+        if first_step >= self.end_step:
+            self.done = True  # range skipped entirely (e.g. resume past it)
+            return
+        last = first_step + max(1, n_steps) - 1
+        if last >= self.start_step:
+            self._start(self.trace_dir)
+            self.active = True
+
+    def on_step(self, global_step: int) -> None:
+        """``global_step`` steps have completed; stop once the range has
+        fully executed (its last step is END - 1, half-open range)."""
+        if self.active and global_step >= self.end_step - 1:
+            self._stop()
+            self.active = False
+            self.done = True
+
+    def close(self) -> None:
+        """Stop an armed trace (end of training inside the range)."""
+        if self.active:
+            self._stop()
+            self.active = False
+            self.done = True

@@ -54,6 +54,11 @@ def main(argv=None) -> int:
         if result["history"] else None,
         "eval": result.get("eval"),
     }
+    obs = trainer.obs_summary()
+    if obs is not None:
+        # Telemetry rollup (train.obs=basic|full): span percentiles +
+        # counters in the same summary line the run already emits.
+        summary["obs"] = obs
     print0(json.dumps(summary))
     return 0
 
