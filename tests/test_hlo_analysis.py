@@ -69,11 +69,13 @@ def test_shipped_steps_have_one_combinable_gradient_group(repo_hlo):
     """Replicated-mode train-step modules contain only all-reduces: a
     single combinable gradient group (full-mesh replica groups, add) plus
     the two metric scalars — no all-gather/reduce-scatter/permute
-    anywhere."""
+    anywhere. (Serve programs have their own schedule contract —
+    `test_serve_programs_in_artifact`.)"""
     _, artifact = repo_hlo
     checked = 0
     for name, rec in artifact["programs"].items():
-        if rec["update_sharding"] != "replicated":
+        if rec["update_sharding"] != "replicated" \
+                or name.startswith("serve_step"):
             continue
         checked += 1
         assert set(rec["counts"]) <= {"all-reduce"}, (name, rec["counts"])
@@ -83,6 +85,33 @@ def test_shipped_steps_have_one_combinable_gradient_group(repo_hlo):
             assert rec["grad_reduce_ops"] >= 1, name
         assert rec["metric_allreduce_ops"] == 2, (name, rec)
     assert checked >= 3
+
+
+def test_serve_programs_in_artifact(repo_hlo):
+    """The serving forwards are fingerprinted alongside the train steps
+    (docs/SERVING.md "Analyzer contract"): a world-divisible bucket
+    compiles to exactly the two stats reductions (one [C] vector, one
+    scalar; identical full-mesh groups, add) with nothing else, a
+    sub-world bucket compiles to ZERO collectives, and the donated
+    ServeStats leaves are proven aliased in both."""
+    _, artifact = repo_hlo
+    serve = {k: v for k, v in artifact["programs"].items()
+             if k.startswith("serve_step")}
+    assert set(serve) == {"serve_step@b16", "serve_step@b2"}
+    big, small = serve["serve_step@b16"], serve["serve_step@b2"]
+    # Fan-out bucket: batch sharded over data; only the stats reduce.
+    assert big["counts"] == {"all-reduce": 2}, big["counts"]
+    assert big["grad_reduce_ops"] == 1 and big["metric_allreduce_ops"] == 1
+    groups = {op["replica_groups"] for op in big["collectives"]}
+    reductions = {op["reduction"] for op in big["collectives"]}
+    assert len(groups) == 1 and reductions == {"add"}, (groups, reductions)
+    # Sub-world bucket: replicated compute, zero collectives.
+    assert small["counts"] == {}, small["counts"]
+    # Donated-buffer forward: the ServeStats pytree aliases in place.
+    for name, rec in serve.items():
+        assert rec["aliased_inputs"] == rec["donated_inputs"] == 2, (
+            name, rec)
+    assert big["digest"] != small["digest"]
 
 
 def test_shipped_sharded_steps_have_scatter_update_gather_schedule(repo_hlo):

@@ -1,0 +1,517 @@
+"""tpu_dp.serve — batched-inference subsystem tests (docs/SERVING.md).
+
+What must hold, in order of importance:
+
+1. **Correctness under batching**: a request's predictions are identical
+   to running the model directly on its images — coalescing, padding, and
+   bucket choice can never leak into results.
+2. **Zero retraces**: after one warmup call per bucket, a 200-request
+   mixed-size load hits only pre-compiled programs (the RecompileGuard
+   raises otherwise — the engine's default).
+3. **Exact books**: the loadgen's caller-side ground truth (accepted /
+   shed-by-reason / completed / deadline-missed, image counts) matches
+   the `tpu_dp.obs` serve counters and the device-side donated stats
+   EXACTLY — telemetry that can drift from truth is worse than none.
+4. **Attributable faults**: a deterministic `TPU_DP_FAULT=delay:`
+   straggler during serving surfaces in the obs heartbeats and in the
+   affected requests' device spans, with the books still exact.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_dp.obs.counters import counters
+from tpu_dp.serve import (
+    SHED_DEADLINE,
+    SHED_QUEUE_FULL,
+    BucketLadder,
+    DynamicBatcher,
+    InferenceEngine,
+    RequestQueue,
+    ShedError,
+    arrival_offsets,
+    parse_buckets,
+    run_load,
+)
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def net_model():
+    from tpu_dp.models import build_model
+
+    model = build_model("net")
+    variables = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
+        train=False,
+    )
+    return model, variables["params"]
+
+
+def make_engine(net_model, **kw):
+    model, params = net_model
+    kw.setdefault("buckets", (1, 2, 4, 8))
+    kw.setdefault("slo_ms", 500.0)
+    return InferenceEngine(model, params, **kw)
+
+
+def direct_predictions(net_model, images_u8):
+    """The unbatched reference forward for a request's images."""
+    from tpu_dp.data.cifar import normalize
+
+    model, params = net_model
+    logits = model.apply(
+        {"params": params}, normalize(np.asarray(images_u8)), train=False
+    )
+    return np.asarray(logits.argmax(axis=-1))
+
+
+# -- ladder + batcher (pure logic) ----------------------------------------
+
+def test_bucket_ladder_pick_and_validation():
+    ladder = BucketLadder((1, 2, 4, 8))
+    assert ladder.max_batch == 8
+    assert [ladder.pick(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    with pytest.raises(ValueError):
+        ladder.pick(9)
+    with pytest.raises(ValueError):
+        ladder.pick(0)
+    with pytest.raises(ValueError):
+        BucketLadder(())
+    with pytest.raises(ValueError):
+        BucketLadder((4, 2))  # not ascending
+    with pytest.raises(ValueError):
+        BucketLadder((2, 2, 4))  # duplicate
+    with pytest.raises(ValueError):
+        BucketLadder((0, 2))
+
+
+def test_parse_buckets():
+    assert parse_buckets("1,2,4") == (1, 2, 4)
+    with pytest.raises(ValueError):
+        parse_buckets("")
+    with pytest.raises(ValueError):
+        parse_buckets("1,x")
+
+
+def _mk_queue(**kw):
+    kw.setdefault("max_depth", 8)
+    kw.setdefault("default_slo_ms", 1000.0)
+    return RequestQueue(**kw)
+
+
+def test_queue_sheds_on_depth_with_reason_and_counters():
+    q = _mk_queue(max_depth=2)
+    before = counters.get("serve.shed.queue_full")
+    q.submit(np.zeros((1, 32, 32, 3), np.uint8))
+    q.submit(np.zeros((1, 32, 32, 3), np.uint8))
+    with pytest.raises(ShedError) as ei:
+        q.submit(np.zeros((1, 32, 32, 3), np.uint8))
+    assert ei.value.reason == SHED_QUEUE_FULL
+    assert counters.get("serve.shed.queue_full") == before + 1
+
+
+def test_queue_rejects_malformed_requests():
+    q = _mk_queue(max_request=4)
+    with pytest.raises(ValueError):
+        q.submit(np.zeros((1, 16, 16, 3), np.uint8))  # wrong shape
+    with pytest.raises(ValueError):
+        q.submit(np.zeros((1, 32, 32, 3), np.float32))  # wrong dtype
+    with pytest.raises(ValueError):
+        q.submit(np.zeros((5, 32, 32, 3), np.uint8))  # above max bucket
+    assert len(q) == 0  # nothing was admitted
+
+
+def test_queue_sheds_at_admission_below_headroom():
+    q = _mk_queue(shed_headroom_ms=10.0)
+    with pytest.raises(ShedError) as ei:
+        q.submit(np.zeros((1, 32, 32, 3), np.uint8), slo_ms=5.0)
+    assert ei.value.reason == SHED_DEADLINE
+    # A budget above the headroom is admitted.
+    h = q.submit(np.zeros((1, 32, 32, 3), np.uint8), slo_ms=50.0)
+    assert not h.done()
+
+
+def test_queue_collect_expires_coalesces_fifo_never_splits():
+    q = _mk_queue(max_depth=16)
+    h_exp = q.submit(np.zeros((1, 32, 32, 3), np.uint8), slo_ms=0.0)
+    h1 = q.submit(np.ones((2, 32, 32, 3), np.uint8))
+    h2 = q.submit(np.ones((3, 32, 32, 3), np.uint8))
+    h3 = q.submit(np.ones((4, 32, 32, 3), np.uint8))  # 2+3+4 > 8: no split
+    batch, expired = q.collect(max_images=8)
+    assert [r.handle for r in expired] == [h_exp]
+    assert h_exp.done() and h_exp.shed_reason == SHED_DEADLINE
+    assert [r.handle for r in batch] == [h1, h2]  # FIFO prefix that fits
+    assert len(q) == 1  # h3 stays whole for the next batch
+    batch2, _ = q.collect(max_images=8)
+    assert [r.handle for r in batch2] == [h3]
+
+
+def test_batcher_pads_masks_and_slices():
+    q = _mk_queue()
+    b = DynamicBatcher(q, BucketLadder((1, 2, 4, 8)), max_wait_ms=1.0)
+    q.submit(np.full((2, 32, 32, 3), 7, np.uint8))
+    q.submit(np.full((1, 32, 32, 3), 9, np.uint8))
+    reqs, expired = q.collect(8)
+    formed = b.form(reqs, expired, time.perf_counter())
+    assert formed.bucket == 4 and formed.valid == 3
+    assert formed.images.shape == (4, 32, 32, 3)
+    assert formed.weight.tolist() == [1.0, 1.0, 1.0, 0.0]
+    assert (formed.images[formed.slices[0]] == 7).all()
+    assert (formed.images[formed.slices[1]] == 9).all()
+    assert (formed.images[3] == 0).all()  # padding rows are zero
+    assert formed.occupancy == pytest.approx(0.75)
+
+
+def test_await_work_fill_and_wait_triggers():
+    q = _mk_queue(max_depth=32)
+    # Fill trigger: pending images reach the target immediately.
+    q.submit(np.zeros((4, 32, 32, 3), np.uint8))
+    assert q.await_work(target_images=4, max_wait_s=60.0, timeout_s=1.0) \
+        == "fill"
+    q.collect(8)
+    # Wait trigger: one small request, short max_wait.
+    q.submit(np.zeros((1, 32, 32, 3), np.uint8))
+    t0 = time.perf_counter()
+    assert q.await_work(target_images=8, max_wait_s=0.02, timeout_s=5.0) \
+        == "wait"
+    assert time.perf_counter() - t0 < 2.0
+    q.collect(8)
+    # Timeout trigger: empty queue.
+    assert q.await_work(8, 0.02, timeout_s=0.01) == "timeout"
+    # Timeout with PENDING work younger than max_wait: must NOT dispatch
+    # — returning "wait" here would silently cap the configured max_wait
+    # at the dispatch loop's poll interval.
+    q.submit(np.zeros((1, 32, 32, 3), np.uint8))
+    assert q.await_work(8, max_wait_s=10.0, timeout_s=0.01) == "timeout"
+    q.collect(8)
+    # Closed + drained.
+    q.close()
+    assert q.await_work(8, 0.02, timeout_s=1.0) == "closed"
+
+
+# -- the engine ------------------------------------------------------------
+
+def test_engine_predictions_match_direct_forward(net_model):
+    rng = np.random.default_rng(3)
+    engine = make_engine(net_model)
+    with engine:
+        payloads = [
+            rng.integers(0, 256, size=(k, 32, 32, 3)).astype(np.uint8)
+            for k in (1, 3, 2, 4, 1, 2)
+        ]
+        handles = [engine.submit(p) for p in payloads]
+        for p, h in zip(payloads, handles):
+            assert h.wait(30.0)
+            assert h.ok
+            np.testing.assert_array_equal(
+                h.predictions, direct_predictions(net_model, p)
+            )
+            assert h.confidence.shape == (p.shape[0],)
+            assert ((h.confidence > 0) & (h.confidence <= 1)).all()
+
+
+def test_engine_200_request_mixed_load_zero_retraces_exact_books(net_model):
+    """The acceptance-criteria run (ISSUE 6): 200 mixed-size requests on
+    the 8-device CPU mesh — zero post-warmup retraces, per-request
+    percentiles + SLO attainment from obs spans, and shed/deadline
+    counters exactly consistent with the loadgen's ground truth."""
+    assert jax.device_count() == 8
+    retraces_before = counters.get("recompile.retraces")
+    engine = make_engine(net_model, buckets=(1, 2, 4, 8, 16, 32),
+                         slo_ms=500.0)
+    warm = engine.warmup()
+    assert set(warm) == {1, 2, 4, 8, 16, 32}
+    engine.start(warmup=False)
+    try:
+        report = run_load(engine, n_requests=200, pattern="poisson",
+                          rate_rps=600.0, sizes=(1, 2, 3, 4), seed=1)
+    finally:
+        engine.stop()
+    truth = report["ground_truth"]
+    assert truth["submitted"] == 200
+    assert truth["completed"] == truth["accepted"] == 200
+    assert truth["unresolved"] == 0
+    assert report["consistent"], (truth, report["counters"])
+    # Zero retraces: per-guard and in the global recompile counter.
+    assert report["retraces"] == 0
+    assert counters.get("recompile.retraces") == retraces_before
+    # Percentiles + attainment come from the recorded spans.
+    assert report["latency_ms"]["n"] == 200
+    assert report["latency_ms"]["p50_ms"] <= report["latency_ms"]["p95_ms"] \
+        <= report["latency_ms"]["p99_ms"]
+    assert report["slo"]["attainment"] is not None
+    for span in ("queue_wait", "batch_form", "h2d", "device", "d2h"):
+        assert report["spans"][span]["n"] == 200, span
+    # Device-side ground truth: the donated stats counted every real
+    # image exactly once (padding never leaks in).
+    assert report["device_stats"]["served"] == truth["images_served"]
+    assert sum(report["device_stats"]["class_counts"]) \
+        == truth["images_served"]
+    # Mixed sizes actually exercised multiple buckets.
+    assert len(report["bucket_counts"]) >= 2
+
+
+def test_burst_overload_sheds_with_exact_books(net_model):
+    """A burst into a tiny queue must shed (queue_full), and every shed
+    must be visible to BOTH sides identically."""
+    engine = make_engine(net_model, buckets=(1, 2, 4), max_queue=3,
+                         max_wait_ms=20.0)
+    with engine:
+        report = run_load(engine, n_requests=60, pattern="burst",
+                          burst=20, rate_rps=5000.0, sizes=(1, 2), seed=2)
+    truth = report["ground_truth"]
+    assert truth["shed"] > 0
+    assert truth["shed_by_reason"].get(SHED_QUEUE_FULL, 0) > 0
+    assert truth["completed"] + truth["shed"] == 60
+    assert report["consistent"], (truth, report["counters"])
+
+
+def test_zero_budget_requests_all_shed_or_missed(net_model):
+    """slo_ms=0: every admitted request either sheds on expiry or
+    completes past its deadline — nothing can be silently on-time."""
+    engine = make_engine(net_model)
+    with engine:
+        report = run_load(engine, n_requests=20, pattern="poisson",
+                          rate_rps=2000.0, sizes=(1,), slo_ms=0.0, seed=3)
+    truth = report["ground_truth"]
+    assert truth["completed"] + truth["shed"] == 20
+    assert truth["shed"] + truth["deadline_missed"] == 20
+    assert report["consistent"], (truth, report["counters"])
+
+
+def test_fault_delay_surfaces_in_heartbeats_and_spans(net_model, tmp_path):
+    """A TPU_DP_FAULT=delay: straggler during serving is attributable:
+    the delayed batch's heartbeat shows the inflated step time, the
+    affected requests' device span carries the delay, and the books stay
+    exact (ISSUE 6 satellite)."""
+    delay_ms = 250.0
+    engine = make_engine(
+        net_model,
+        obs_dir=str(tmp_path),
+        fault=f"delay:step=2,ms={delay_ms:.0f}",
+    )
+    with engine:
+        handles = []
+        for i in range(5):  # sequential singles → one batch per request
+            h = engine.submit(
+                np.full((1, 32, 32, 3), i, np.uint8)
+            )
+            assert h.wait(30.0) and h.ok
+            handles.append(h)
+    # Spans: exactly the delayed batch's requests carry the delay.
+    slow = [h for h in handles if h.spans["device"] >= delay_ms * 0.9]
+    assert len(slow) == 1, [round(h.spans["device"], 1) for h in handles]
+    # Heartbeats: the straggling batch is visible from the files alone.
+    beats = []
+    for line in (tmp_path / "heartbeat_r00000.jsonl").read_text().splitlines():
+        beats.append(json.loads(line))
+    assert len(beats) == 5
+    slow_beats = [b for b in beats if b["step_ms"] >= delay_ms * 0.9]
+    assert len(slow_beats) == 1
+    # batch_index is 0-based when the injector fires at step>=2 → the
+    # third batch; its heartbeat step counter is 3 (1-based post-beat).
+    assert slow_beats[0]["step"] == 3
+    # Books stay exact around the fault.
+    assert engine.device_stats()["served"] == 5
+    assert engine.retraces == 0
+
+
+def test_stop_without_drain_sheds_pending_quickly(net_model):
+    """stop(drain=False) must abandon, not drain: a request parked behind
+    a long batching window is shed with reason `closed` and the shutdown
+    returns promptly instead of serving out the queue."""
+    engine = make_engine(net_model, max_wait_ms=30_000.0)  # parks requests
+    engine.start()
+    h = engine.submit(np.zeros((1, 32, 32, 3), np.uint8))
+    t0 = time.perf_counter()
+    engine.stop(drain=False)
+    assert time.perf_counter() - t0 < 5.0  # not the 30s batching window
+    assert h.done() and h.shed_reason == "closed"
+
+
+def test_engine_error_sheds_queued_requests(net_model):
+    """A dispatch-thread failure must not leave callers blocked: queued
+    requests shed with reason engine_error and stop() re-raises."""
+    engine = make_engine(net_model, fault="kill:step=10000")  # inert
+    engine.start()
+
+    def boom(*a, **k):
+        raise RuntimeError("injected dispatch failure")
+
+    # Replace every bucket program with a failing one.
+    for bucket in engine.ladder.buckets:
+        engine._programs[bucket] = boom
+    h = engine.submit(np.zeros((1, 32, 32, 3), np.uint8))
+    assert h.wait(30.0)
+    assert h.shed_reason == "engine_error"
+    with pytest.raises(RuntimeError, match="dispatch thread failed"):
+        engine.stop()
+
+
+# -- checkpoint satellite ---------------------------------------------------
+
+def test_load_params_only_roundtrip_ignores_opt_layout(tmp_path, mesh8):
+    """Params-only load: exact round trip, no optimizer needed — including
+    from a checkpoint whose opt state was written in the SHARDED layout
+    (flat 1-D shards the inference side knows nothing about)."""
+    from tpu_dp.checkpoint import (
+        load_params_only, save_checkpoint,
+    )
+    from tpu_dp.models import build_model
+    from tpu_dp.train import SGD, create_train_state, shard_optimizer
+
+    model = build_model("net")
+    opt = shard_optimizer(SGD(momentum=0.9), 8)
+    state = create_train_state(
+        model, jax.random.PRNGKey(7),
+        np.zeros((1, 32, 32, 3), np.float32), opt,
+    )
+    save_checkpoint(tmp_path, state, {"config": {"model": {"name": "net"}}})
+    variables = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
+        train=False,
+    )
+    params, batch_stats, meta = load_params_only(
+        tmp_path, variables["params"]
+    )
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(state.params),
+    ):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert batch_stats == {}
+    assert meta["config"]["model"]["name"] == "net"
+
+
+def test_load_params_only_rejects_bare_params_export(tmp_path):
+    from tpu_dp.checkpoint import load_params_only, save_params
+    from tpu_dp.models import build_model
+
+    model = build_model("net")
+    variables = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
+        train=False,
+    )
+    save_params(tmp_path / "state.msgpack", variables["params"])
+    with pytest.raises(ValueError, match="load_params"):
+        load_params_only(tmp_path, variables["params"])
+
+
+def test_engine_from_checkpoint_serves_trained_params(tmp_path, net_model):
+    """End to end: a CheckpointManager-written training checkpoint serves
+    via from_checkpoint (model rebuilt from meta, params-only), and its
+    predictions equal the direct forward on the restored params."""
+    from tpu_dp.checkpoint import CheckpointManager
+    from tpu_dp.models import build_model
+    from tpu_dp.train import SGD, create_train_state
+
+    model = build_model("net")
+    state = create_train_state(
+        model, jax.random.PRNGKey(11),
+        np.zeros((1, 32, 32, 3), np.float32), SGD(momentum=0.9),
+    )
+    with CheckpointManager(tmp_path, async_save=False) as mgr:
+        mgr.save(state, {"config": {"model": {"name": "net"},
+                                    "data": {"dataset": "cifar10"}}},
+                 step=5)
+    engine = InferenceEngine.from_checkpoint(
+        tmp_path, buckets=(1, 2, 4), slo_ms=500.0
+    )
+    rng = np.random.default_rng(5)
+    images = rng.integers(0, 256, size=(3, 32, 32, 3)).astype(np.uint8)
+    with engine:
+        h = engine.submit(images)
+        assert h.wait(30.0) and h.ok
+    expected = direct_predictions((model, state.params), images)
+    np.testing.assert_array_equal(h.predictions, expected)
+
+
+# -- meter satellite --------------------------------------------------------
+
+def test_meter_mark_credits_variable_batch_sizes():
+    """Serve metering: batch sizes vary per bucket and are credited at the
+    fence (mark), not at dispatch — including the window-opening batch,
+    whose execution lands inside the window."""
+    from tpu_dp.utils import ThroughputMeter
+
+    m = ThroughputMeter(warmup_steps=1)
+    m.step(0)        # warmup dispatch: opens the window
+    m.mark(8)        # its fence is in-window → its 8 images count
+    m.step(0)
+    time.sleep(0.002)
+    m.mark(2)
+    m.step(0)
+    time.sleep(0.002)
+    last = m.mark(32)
+    assert m.elapsed > 0 and m._last == last
+    assert m.images_per_sec == pytest.approx((8 + 2 + 32) / m.elapsed)
+    # Warmup fences (window not open) are never credited.
+    m.reset()
+    assert m.mark(100) and m.images_per_sec == 0.0
+
+
+def test_meter_plain_mark_keeps_training_semantics():
+    """mark() without images must behave exactly as before (the trainer's
+    fence): extends the window, credits nothing."""
+    from tpu_dp.utils import ThroughputMeter
+
+    m = ThroughputMeter(warmup_steps=1)
+    m.step(10)
+    m.step(10)
+    dispatch_elapsed = m.elapsed
+    time.sleep(0.002)
+    m.mark()
+    assert m.elapsed > dispatch_elapsed
+    assert m.images_per_sec == pytest.approx(10 / m.elapsed)
+
+
+# -- config + loadgen plumbing ---------------------------------------------
+
+def test_serve_config_roundtrip_and_overrides():
+    from tpu_dp.config import Config
+
+    cfg = Config()
+    cfg.override("serve.buckets", "1,2,4")
+    cfg.override("serve.slo_ms", "25.5")
+    cfg.override("serve.max_queue", "64")
+    d = cfg.to_dict()
+    assert d["serve"]["buckets"] == "1,2,4"
+    cfg2 = Config.from_dict(d)
+    assert cfg2.serve.slo_ms == 25.5 and cfg2.serve.max_queue == 64
+
+
+def test_engine_from_serve_config(net_model):
+    from tpu_dp.config import ServeConfig
+
+    model, params = net_model
+    engine = InferenceEngine.from_serve_config(
+        model, params, ServeConfig(buckets="1,4", slo_ms=99.0)
+    )
+    assert engine.ladder.buckets == (1, 4)
+    assert engine.slo_ms == 99.0
+
+
+def test_arrival_offsets_patterns():
+    rng = np.random.default_rng(0)
+    pois = arrival_offsets(50, "poisson", 100.0, 8, rng)
+    assert len(pois) == 50 and (np.diff(pois) >= 0).all() and pois[0] == 0
+    burst = arrival_offsets(20, "burst", 100.0, 5, rng)
+    # Groups of 5 share an arrival time; gaps between groups hold the rate.
+    assert (burst[:5] == burst[0]).all()
+    assert burst[5] > burst[4]
+    assert len(arrival_offsets(0, "poisson", 100.0, 8, rng)) == 0
+    with pytest.raises(ValueError):
+        arrival_offsets(5, "steady", 100.0, 8, rng)
+    with pytest.raises(ValueError):
+        arrival_offsets(5, "poisson", 0.0, 8, rng)

@@ -20,6 +20,15 @@
 #                                    # smoke run (archives its metrics.jsonl
 #                                    # and Perfetto trace under artifacts/)
 #                                    # + the -m obs tests.
+#   tools/run_tier1.sh --serve       # serving lane: a 200-request mixed-
+#                                    # size synthetic load through the full
+#                                    # queue → batcher → compiled-forward
+#                                    # pipeline on the 8-device CPU mesh
+#                                    # (exit 1 on any counter/ground-truth
+#                                    # mismatch or post-warmup retrace;
+#                                    # archives artifacts/serve_report.json
+#                                    # with SLO attainment + shed counts)
+#                                    # + the -m serve tests.
 #
 # Exit code is pytest's; the DOTS_PASSED line echoes the pass count the
 # roadmap tracks across PRs.
@@ -72,6 +81,19 @@ if [ "${1:-}" = "--obs" ]; then
     rm -rf "$SMOKE"
     echo "obs smoke: artifacts/metrics.jsonl + artifacts/trace.perfetto.json"
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--serve" ]; then
+    # The serve smoke is its own verdict (exit 1 when the loadgen ground
+    # truth and the serve counters disagree, or any bucket program
+    # retraced after warmup); the report is the CI artifact reviewers
+    # diff for SLO-attainment / shed-count regressions.
+    mkdir -p artifacts
+    env JAX_PLATFORMS=cpu python -m tpu_dp.serve --requests 200 \
+        --out artifacts/serve_report.json > /dev/null || exit $?
+    echo "serve smoke: artifacts/serve_report.json"
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serve \
         -p no:cacheprovider
 fi
 

@@ -20,12 +20,14 @@ from tpu_dp import (
     ops,
     parallel,
     resilience,
+    serve,
     train,
     utils,
 )
 from tpu_dp.checkpoint import (
     CheckpointManager,
     load_checkpoint,
+    load_params_only,
     save_checkpoint,
 )
 from tpu_dp.config import Config
@@ -43,6 +45,7 @@ __all__ = [
     "data",
     "dist",
     "load_checkpoint",
+    "load_params_only",
     "metrics",
     "models",
     "obs",
@@ -50,6 +53,7 @@ __all__ = [
     "parallel",
     "resilience",
     "save_checkpoint",
+    "serve",
     "train",
     "utils",
 ]

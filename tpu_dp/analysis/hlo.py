@@ -522,6 +522,36 @@ def shipped_programs(
         (state, _example_batch(batch)),
         spec(step_mod.make_eval_step, 0, 2, False),
     )
+    # The serving forwards (`tpu_dp.serve`, docs/SERVING.md): one program
+    # per batch bucket, donating the ServeStats pytree (2 leaves — DP303
+    # must prove the aliasing for serving too). A bucket divisible by the
+    # world shards the batch over ``data`` and reduces only the two stats
+    # values (one scalar, one [C] vector — the non-scalar one plays the
+    # "gradient" role in DP301's replicated classification); a smaller
+    # bucket runs replicated and must compile to ZERO collectives.
+    import jax.numpy as jnp
+
+    serve_state = state.replace(opt_state={})  # params-only, like serving
+    serve_buckets = [(2 * world, 1, True)]   # sharded fan-out bucket
+    if world > 1:
+        # sub-world bucket: replicated, no comms (on a 1-device "mesh"
+        # it would collide with the bucket above).
+        serve_buckets.append((2, 0, False))
+    for bucket, metric_count, expect_reduce in serve_buckets:
+        yield (
+            f"serve_step@b{bucket}",
+            step_mod.make_serve_step(model, mesh, bucket),
+            (
+                step_mod.init_serve_stats(10),
+                serve_state,
+                {
+                    "image": jnp.zeros((bucket, 32, 32, 3), jnp.float32),
+                    "weight": jnp.ones((bucket,), jnp.float32),
+                },
+            ),
+            spec(step_mod.make_serve_step, 2, metric_count,
+                 expect_reduce and world > 1),
+        )
 
 
 def verify_repo_hlo(

@@ -302,6 +302,54 @@ class CheckpointManager:
         self.close()
 
 
+def load_params_only(
+    ckpt_dir: str | os.PathLike,
+    target_params,
+    target_batch_stats=None,
+) -> tuple[Any, Any, dict[str, Any]]:
+    """Inference-side restore: params (+ BN stats) from a full checkpoint,
+    with the optimizer state never materialized.
+
+    The serving path (`tpu_dp.serve.InferenceEngine.from_checkpoint`) needs
+    the model weights out of a *training* checkpoint without paying for —
+    or even knowing about — the optimizer: momentum buffers double the
+    payload it would otherwise place on device, and under
+    ``train.update_sharding=sharded`` their layout additionally depends on
+    the world size the checkpoint was written under. This loader restores
+    only the ``params`` (and, when a target is given, ``batch_stats``)
+    subtrees against their targets; the opt_state subtree is dropped
+    without shape validation, device transfer, or the resharding dance
+    `load_checkpoint` performs — which is exactly why a checkpoint written
+    under ANY world size or update-sharding mode loads here unchanged:
+    params and batch stats are always stored in the canonical global
+    (replicated) layout (`leaf_to_host`), so there is nothing to reshard.
+
+    Returns ``(params, batch_stats, meta)``; ``batch_stats`` is ``{}``
+    when no target is given or the checkpoint carries none.
+    """
+    ckpt_dir = Path(ckpt_dir)
+    payload = (ckpt_dir / _CKPT_NAME).read_bytes()
+    raw = serialization.msgpack_restore(payload)
+    if not isinstance(raw, dict) or "params" not in raw:
+        raise ValueError(
+            f"{ckpt_dir / _CKPT_NAME} is not a TrainState checkpoint "
+            f"(no 'params' subtree) — for a bare `save_params` export use "
+            f"`load_params`"
+        )
+    params = serialization.from_state_dict(
+        _to_host(target_params), raw["params"], name="params"
+    )
+    batch_stats = {}
+    if target_batch_stats:
+        batch_stats = serialization.from_state_dict(
+            _to_host(target_batch_stats), raw.get("batch_stats", {}),
+            name="batch_stats",
+        )
+    meta_path = ckpt_dir / _META_NAME
+    meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
+    return params, batch_stats, meta
+
+
 def save_params(path: str | os.PathLike, params) -> Path | None:
     """Final-weights export — `torch.save(state_dict)` analogue
     (`cifar_example.py:92-93`), written once by process 0, clean key names."""

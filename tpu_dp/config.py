@@ -173,6 +173,32 @@ class ResilienceConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Batched-inference serving (tpu_dp/serve/, docs/SERVING.md)."""
+
+    # Padded batch-size ladder: every formed batch is zero-padded up to
+    # one of these sizes, each with its own pre-compiled donated-buffer
+    # forward — fixed shapes, so the RecompileGuard stays silent.
+    buckets: str = "1,2,4,8,16,32"
+    # Dynamic-batching latency cap: dispatch when the pending work fills
+    # the largest bucket OR the oldest request has waited this long.
+    max_wait_ms: float = 5.0
+    # Queue bound (requests): past this depth `submit` sheds with reason
+    # "queue_full" instead of converting overload into deadline misses.
+    max_queue: int = 256
+    # Per-request latency target; attainment (fraction of completed
+    # requests within it) is reported from the obs spans.
+    slo_ms: float = 50.0
+    # Admission headroom: a request whose deadline budget is already below
+    # this is shed immediately (reason "deadline") — it cannot be served
+    # in time, so reject-now beats serve-late.
+    shed_headroom_ms: float = 0.0
+    # Heartbeat/span directory ("" = disabled): per-batch heartbeats land
+    # here so serve stragglers are attributable with obs.HealthMonitor.
+    obs_dir: str = ""
+
+
+@dataclass
 class ParallelConfig:
     num_devices: int | None = None  # None = all visible devices
     coordinator_address: str | None = None
@@ -189,6 +215,7 @@ class Config:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     def override(self, dotted: str, value: str) -> None:
         """Apply one ``section.field=value`` override, coercing to field type."""

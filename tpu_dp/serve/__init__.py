@@ -1,0 +1,57 @@
+"""tpu_dp.serve — batched inference: queue → dynamic batcher → compiled
+forward (docs/SERVING.md).
+
+The serving half of the "millions of users" north star (ROADMAP item 4),
+built on the training stack's compiled-program discipline: requests enter
+a bounded deadline-aware `RequestQueue`, a `DynamicBatcher` coalesces them
+into zero-padded batches at fixed **bucket** sizes (a ladder like
+1/2/4/…/32, so every batch hits a pre-compiled `make_serve_step` program
+and the RecompileGuard stays silent), and an `InferenceEngine` dispatch
+thread runs the donated-buffer forward across the data-mesh replicas.
+Per-request latency is measured with `tpu_dp.obs` spans
+(queue_wait/batch_form/h2d/device/d2h), shed/SLO accounting lands in the
+process-wide counter registry, and the serve programs are fingerprinted in
+dplint's Level-3 artifact alongside the train steps.
+
+``python -m tpu_dp.serve`` runs the synthetic-load CPU smoke
+(`tools/run_tier1.sh --serve` archives its report).
+"""
+
+from tpu_dp.serve.batcher import (
+    DEFAULT_BUCKETS,
+    BucketLadder,
+    DynamicBatcher,
+    FormedBatch,
+    parse_buckets,
+)
+from tpu_dp.serve.engine import SERVE_SPANS, InferenceEngine
+from tpu_dp.serve.loadgen import ARRIVAL_PATTERNS, arrival_offsets, run_load
+from tpu_dp.serve.queue import (
+    SHED_CLOSED,
+    SHED_DEADLINE,
+    SHED_QUEUE_FULL,
+    Request,
+    RequestHandle,
+    RequestQueue,
+    ShedError,
+)
+
+__all__ = [
+    "ARRIVAL_PATTERNS",
+    "BucketLadder",
+    "DEFAULT_BUCKETS",
+    "DynamicBatcher",
+    "FormedBatch",
+    "InferenceEngine",
+    "Request",
+    "RequestHandle",
+    "RequestQueue",
+    "SERVE_SPANS",
+    "SHED_CLOSED",
+    "SHED_DEADLINE",
+    "SHED_QUEUE_FULL",
+    "ShedError",
+    "arrival_offsets",
+    "parse_buckets",
+    "run_load",
+]

@@ -1,0 +1,135 @@
+"""`python -m tpu_dp.serve` — the synthetic-load serving smoke.
+
+Drives a freshly-initialized (or checkpointed) model through the full
+serve pipeline on the current backend — on CPU it forces the 8-virtual-
+device mesh, the same harness the tests use — and prints the audited
+report JSON. Exit code is the verdict:
+
+- 0: every request accounted for, loadgen ground truth == serve counters
+  exactly, and zero post-warmup retraces;
+- 1: the run completed but the audit failed (inconsistent books or a
+  retrace — a serving-correctness regression);
+- 2: usage error.
+
+`tools/run_tier1.sh --serve` runs this at 200 requests and archives the
+report as ``artifacts/serve_report.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dp.serve",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--pattern", default="poisson",
+                    choices=["poisson", "burst"])
+    ap.add_argument("--rate-rps", type=float, default=400.0)
+    ap.add_argument("--burst", type=int, default=8)
+    ap.add_argument("--sizes", default="1,2,3,4",
+                    help="request image-count choices (mixed-size traffic)")
+    ap.add_argument("--buckets", default="1,2,4,8,16,32",
+                    help="padded batch-size ladder")
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--slo-ms", type=float, default=250.0,
+                    help="per-request latency target (generous on CPU)")
+    ap.add_argument("--model", default="net")
+    ap.add_argument("--ckpt", default=None,
+                    help="serve params from this checkpoint dir "
+                         "(InferenceEngine.from_checkpoint) instead of a "
+                         "fresh init")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None,
+                    help="also write the report JSON here")
+    args = ap.parse_args(argv)
+
+    # Backend pinning BEFORE jax imports: the smoke must exercise the
+    # multi-replica fan-out, so on CPU expose 8 virtual devices (the
+    # tests' harness, tests/conftest.py).
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from tpu_dp.models import build_model
+    from tpu_dp.serve import InferenceEngine, parse_buckets, run_load
+
+    try:
+        buckets = parse_buckets(args.buckets)
+        sizes = tuple(int(s) for s in args.sizes.split(",") if s.strip())
+    except ValueError as e:
+        print(f"serve: {e}", file=sys.stderr)
+        return 2
+
+    common = dict(
+        buckets=buckets,
+        max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue,
+        slo_ms=args.slo_ms,
+    )
+    if args.ckpt:
+        engine = InferenceEngine.from_checkpoint(args.ckpt, **common)
+    else:
+        model = build_model(args.model)
+        variables = model.init(
+            jax.random.PRNGKey(args.seed),
+            np.zeros((1, 32, 32, 3), np.float32),
+            train=False,
+        )
+        engine = InferenceEngine(
+            model, variables["params"],
+            batch_stats=variables.get("batch_stats") or None,
+            **common,
+        )
+
+    engine.start()
+    try:
+        report = run_load(
+            engine,
+            n_requests=args.requests,
+            pattern=args.pattern,
+            rate_rps=args.rate_rps,
+            sizes=sizes,
+            burst=args.burst,
+            seed=args.seed,
+        )
+    finally:
+        engine.stop()
+
+    payload = json.dumps(report, indent=2, sort_keys=True)
+    print(payload)
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(payload + "\n")
+
+    ok = report["consistent"] and report["retraces"] == 0
+    if not ok:
+        print(
+            f"serve: AUDIT FAILED — consistent={report['consistent']} "
+            f"retraces={report['retraces']}",
+            file=sys.stderr,
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

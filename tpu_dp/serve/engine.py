@@ -1,0 +1,518 @@
+"""The inference engine: per-bucket compiled forwards + the dispatch loop.
+
+The serving half of the north star (ROADMAP item 4): the same compiled-
+program discipline the trainer enforces — fixed shapes, donated state, a
+fingerprinted collective schedule — applied to request traffic:
+
+    submit() → RequestQueue → DynamicBatcher → per-bucket jitted
+    `make_serve_step` → resolve handles
+
+One dispatch thread drains the queue. Every bucket in the ladder gets its
+own pre-compiled program (warmed up at `start`), wrapped in a
+`RecompileGuard` with ``on_retrace="raise"`` by default: a retrace during
+serving means a shape/dtype leaked past the batcher, and the engine treats
+that as a bug, not a slow path. The params/batch_stats live in a
+`TrainState` with an *empty* opt_state (`checkpoint.load_params_only` —
+inference never materializes optimizer slots); the device-mesh replicas
+give batch fan-out for free (see `make_serve_step`).
+
+Telemetry (docs/OBSERVABILITY.md, docs/SERVING.md): per-request spans
+``queue_wait / batch_form / h2d / device / d2h`` (+ ``total``) in a
+`SpanRecorder`; counters ``serve.accepted / serve.shed[.reason] /
+serve.completed / serve.deadline_missed / serve.batches`` and the
+``serve.batch_occupancy`` gauge in the process-wide registry; per-batch
+heartbeats via `HeartbeatWriter` when ``obs_dir`` is set, so a straggling
+serve rank is attributable with the exact `HealthMonitor` tooling the
+trainer uses. The deterministic fault injector (``TPU_DP_FAULT=delay:…``)
+is consulted per batch inside the device span, so injected stragglers
+surface in spans and heartbeats like real ones.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from tpu_dp.obs.counters import Counters, counters as _global_counters
+from tpu_dp.obs.spans import SpanRecorder
+from tpu_dp.serve.batcher import BucketLadder, DynamicBatcher, FormedBatch
+from tpu_dp.serve.queue import SHED_CLOSED, RequestHandle, RequestQueue
+
+#: per-request span names, in pipeline order (the serving analogue of
+#: `tpu_dp.obs.spans.STEP_SPANS`).
+SERVE_SPANS = ("queue_wait", "batch_form", "h2d", "device", "d2h")
+
+
+class InferenceEngine:
+    """Batched-inference engine over the data mesh (docs/SERVING.md)."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        batch_stats=None,
+        mesh=None,
+        buckets=None,
+        max_wait_ms: float = 5.0,
+        max_queue: int = 256,
+        slo_ms: float = 50.0,
+        shed_headroom_ms: float = 0.0,
+        image_shape: tuple[int, int, int] = (32, 32, 3),
+        image_dtype=np.uint8,
+        num_classes: int | None = None,
+        obs_dir: str | None = None,
+        span_capacity: int = 4096,
+        on_retrace: str = "raise",
+        fault: str = "",
+        registry: Counters | None = None,
+    ):
+        import jax
+
+        from tpu_dp.parallel import dist
+        from tpu_dp.parallel.sharding import (
+            batch_sharding, replicated_sharding,
+        )
+        from tpu_dp.resilience.faultinject import FaultInjector
+        from tpu_dp.train.state import TrainState
+
+        self.model = model
+        self.mesh = dist.data_mesh() if mesh is None else mesh
+        self.ladder = BucketLadder(
+            buckets if buckets is not None else BucketLadder().buckets
+        )
+        self.slo_ms = float(slo_ms)
+        self._counters = _global_counters if registry is None else registry
+        self.queue = RequestQueue(
+            max_depth=max_queue,
+            default_slo_ms=slo_ms,
+            shed_headroom_ms=shed_headroom_ms,
+            image_shape=image_shape,
+            image_dtype=image_dtype,
+            max_request=self.ladder.max_batch,
+            registry=self._counters,
+        )
+        self.batcher = DynamicBatcher(self.queue, self.ladder,
+                                      max_wait_ms=max_wait_ms)
+        self.recorder = SpanRecorder(capacity=span_capacity)
+
+        # Inference state: params (+ BN stats) only, replicated, never
+        # donated. The empty opt_state is the point — serving a checkpoint
+        # must not pay for (or even know about) optimizer slots.
+        repl = replicated_sharding(self.mesh)
+        state = TrainState(
+            step=np.zeros((), np.int32),
+            params=params,
+            opt_state={},
+            batch_stats=batch_stats or {},
+        )
+        self._state = jax.device_put(state, repl)
+        if num_classes is None:
+            from tpu_dp.train.step import _infer_forward
+
+            probe = np.zeros((1,) + tuple(image_shape), np.dtype(image_dtype))
+            shapes = jax.eval_shape(
+                lambda s, b: _infer_forward(model, s, b),
+                self._state, {"image": probe},
+            )
+            num_classes = int(shapes[0].shape[-1])
+        self.num_classes = int(num_classes)
+
+        from tpu_dp.train.step import init_serve_stats
+
+        self._stats = jax.device_put(
+            init_serve_stats(self.num_classes), repl
+        )
+        self._repl = repl
+        self._batch_sharding = {
+            b: (batch_sharding(self.mesh)
+                if b % dist.data_axis_size(self.mesh) == 0 else repl)
+            for b in self.ladder.buckets
+        }
+        self._programs: dict[int, object] = {}
+        self._on_retrace = on_retrace
+        self._fault = FaultInjector.from_spec(fault, rank=jax.process_index())
+        self._hb = None
+        if obs_dir:
+            from tpu_dp.obs.health import HeartbeatWriter
+
+            self._hb = HeartbeatWriter(obs_dir, rank=jax.process_index())
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._error: BaseException | None = None
+        self._batch_index = 0
+        self._bucket_counts: dict[int, int] = {}
+        self._lock = threading.Lock()  # report() vs dispatch-thread state
+
+    # -- programs --------------------------------------------------------
+
+    def _program(self, bucket: int):
+        from tpu_dp.analysis.recompile import RecompileGuard
+        from tpu_dp.train.step import make_serve_step
+
+        prog = self._programs.get(bucket)
+        if prog is None:
+            prog = RecompileGuard(
+                make_serve_step(self.model, self.mesh, bucket),
+                name=f"serve_step@b{bucket}",
+                warmup_calls=1,
+                on_retrace=self._on_retrace,
+            )
+            self._programs[bucket] = prog
+        return prog
+
+    def warmup(self) -> dict[int, float]:
+        """Compile + run every bucket program once; per-bucket wall ms.
+
+        After this, the acceptance bar is ZERO retraces for the rest of
+        the engine's life (`retraces` property; the guards raise by
+        default). Warmup batches are all-padding (weight 0), so the
+        device stats count nothing.
+        """
+        import jax
+
+        times: dict[int, float] = {}
+        for bucket in self.ladder.buckets:
+            t0 = time.perf_counter()
+            # Placed exactly like the live path (`_place_batch`): a warmup
+            # call whose argument signature differs from production calls
+            # would leave the real first request paying the compile.
+            batch = self._place_batch(
+                bucket,
+                np.zeros((bucket,) + self.queue.image_shape,
+                         self.queue.image_dtype),
+                np.zeros((bucket,), np.float32),
+            )
+            self._stats, out = self._program(bucket)(
+                self._stats, self._state, batch
+            )
+            jax.block_until_ready(out)
+            times[bucket] = round((time.perf_counter() - t0) * 1e3, 2)
+        return times
+
+    @property
+    def retraces(self) -> int:
+        """Post-warmup retraces across every bucket program (must stay 0)."""
+        return sum(g.retraces for g in self._programs.values())
+
+    def guard_stats(self) -> list[dict]:
+        return [g.stats() for _, g in sorted(self._programs.items())]
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self, warmup: bool = True) -> "InferenceEngine":
+        """Warm the bucket programs and launch the dispatch thread."""
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        if warmup:
+            self.warmup()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="tpu_dp-serve-dispatch", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Close admission; drain (default) or abandon the queue; join.
+
+        ``drain=False`` is the fast shutdown: the loop exits after at
+        most the in-flight batch, and everything still pending is shed
+        with reason ``closed`` — abandoned callers are unblocked, never
+        left waiting. Re-raises a dispatch-thread failure — an engine
+        that died mid-run must not report a clean shutdown.
+        """
+        self.queue.close()
+        if not drain:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if not drain:
+            # Abandoned requests must not leave callers blocked forever.
+            reqs, _ = self.queue.collect(self.ladder.max_batch * 10**6)
+            for req in reqs:
+                self._counters.inc("serve.shed")
+                self._counters.inc(f"serve.shed.{SHED_CLOSED}")
+                req.handle._shed(SHED_CLOSED)
+        if self._hb is not None:
+            self._hb.close()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("serve dispatch thread failed") from err
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- producer API ----------------------------------------------------
+
+    def submit(self, images, slo_ms: float | None = None) -> RequestHandle:
+        """Enqueue one request (see `RequestQueue.submit`); may shed."""
+        return self.queue.submit(images, slo_ms=slo_ms)
+
+    def _place_batch(self, bucket: int, images: np.ndarray,
+                     weight: np.ndarray):
+        """Host batch → device, under the bucket's sharding (one path for
+        warmup and live dispatch, so their jit signatures cannot differ)."""
+        import jax
+
+        sh = self._batch_sharding[bucket]
+        return jax.device_put(
+            {"image": images, "weight": weight},
+            {"image": sh, "weight": sh},
+        )
+
+    # -- the dispatch loop ----------------------------------------------
+
+    def _loop(self) -> None:
+        batch = None
+        try:
+            while True:
+                if self._stop.is_set():  # abandon mode: stop(drain=False)
+                    return
+                batch = self.batcher.next_batch(timeout_s=0.05)
+                if batch == "closed":
+                    return
+                if batch == "timeout":
+                    continue
+                if self._stop.is_set():
+                    # Abandon a batch formed while stopping — its popped
+                    # requests go back through the shed-on-close path.
+                    for req in batch.requests:
+                        self._counters.inc("serve.shed")
+                        self._counters.inc(f"serve.shed.{SHED_CLOSED}")
+                        req.handle._shed(SHED_CLOSED)
+                    return
+                self._run_batch(batch)
+                batch = None
+        except BaseException as e:  # surfaced by stop()
+            self._error = e
+            # Neither the in-flight batch's requests (already popped) nor
+            # anything still queued may wait forever on a dead loop.
+            self.queue.close()
+            pending = list(batch.requests) if isinstance(batch, FormedBatch) \
+                else []
+            reqs, _ = self.queue.collect(self.ladder.max_batch * 10**6)
+            pending.extend(reqs)
+            for req in pending:
+                if not req.handle.done():
+                    self._counters.inc("serve.shed")
+                    self._counters.inc("serve.shed.engine_error")
+                    req.handle._shed("engine_error")
+
+    def _run_batch(self, batch: FormedBatch) -> None:
+        import jax
+
+        # Expired handles were resolved (shed) by the queue; nothing to
+        # serve in an all-expired wake.
+        if not batch.requests:
+            return
+        t0 = time.perf_counter()
+        dev_batch = self._place_batch(batch.bucket, batch.images,
+                                      batch.weight)
+        jax.block_until_ready(dev_batch)
+        t1 = time.perf_counter()
+        with self._lock:
+            # The donated stats buffer is consumed by the call below, so
+            # report()/device_stats() must never read `self._stats` while
+            # a dispatch is in flight — the lock brackets consumption and
+            # reassignment as one atomic step.
+            if self._fault is not None:
+                # Deterministic straggler/kill injection, bracketed inside
+                # the device span so an injected delay is attributed
+                # exactly like a real slow device (tests/test_serve.py).
+                self._fault.on_step(self._batch_index)
+            self._stats, out = self._program(batch.bucket)(
+                self._stats, self._state, dev_batch
+            )
+            jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        predictions = np.asarray(out["prediction"])
+        confidence = np.asarray(out["confidence"])
+        t3 = time.perf_counter()
+
+        h2d_ms = (t1 - t0) * 1e3
+        device_ms = (t2 - t1) * 1e3
+        d2h_ms = (t3 - t2) * 1e3
+        resolutions = []
+        missed = 0
+        with self._lock:
+            for req, sl in zip(batch.requests, batch.slices):
+                latency_ms = (t3 - req.arrival) * 1e3
+                deadline_missed = t3 > req.deadline
+                missed += int(deadline_missed)
+                spans = {
+                    "queue_wait": max(
+                        0.0,
+                        (batch.formed - req.arrival) * 1e3 - batch.form_ms,
+                    ),
+                    "batch_form": batch.form_ms,
+                    "h2d": h2d_ms,
+                    "device": device_ms,
+                    "d2h": d2h_ms,
+                    "total": latency_ms,
+                }
+                self.recorder.record(req.req_id, spans, ts=req.arrival_ts)
+                resolutions.append(
+                    (req, sl, latency_ms, deadline_missed, spans)
+                )
+            self._bucket_counts[batch.bucket] = (
+                self._bucket_counts.get(batch.bucket, 0) + 1
+            )
+            self._batch_index += 1
+        # Publish counters BEFORE waking any waiter: a caller whose last
+        # handle just resolved must read books that already include it
+        # (the loadgen's exact-consistency audit depends on this order).
+        self._counters.inc("serve.batches")
+        self._counters.inc("serve.completed", len(batch.requests))
+        if missed:
+            self._counters.inc("serve.deadline_missed", missed)
+        self._counters.gauge("serve.batch_occupancy", batch.occupancy)
+        if self._hb is not None:
+            self._hb.beat(
+                step=self._batch_index,
+                step_ms=batch.form_ms + (t3 - t0) * 1e3,
+            )
+        for req, sl, latency_ms, deadline_missed, spans in resolutions:
+            req.handle._resolve(
+                predictions[sl].copy(), confidence[sl].copy(),
+                latency_ms, deadline_missed, spans,
+            )
+
+    # -- reporting -------------------------------------------------------
+
+    def device_stats(self) -> dict:
+        """The donated stats pytree, fetched: device-side ground truth."""
+        with self._lock:
+            served = np.asarray(self._stats["served"])
+            counts = np.asarray(self._stats["class_counts"])
+        return {
+            "served": int(served),
+            "class_counts": [int(c) for c in counts],
+        }
+
+    def report(self) -> dict:
+        """SLO attainment + latency percentiles + shed/bucket accounting.
+
+        Both come from the per-request obs span records: each served
+        request's ``total`` span is its end-to-end latency, and SLO
+        attainment is the fraction of *completed* requests within
+        ``slo_ms`` (shed requests are reported separately — a shed is an
+        explicit rejection, not a silent miss). The recorder is a ring
+        (``span_capacity`` requests), so on a long-lived engine these are
+        the statistics of the most recent window — bounded memory by
+        design, like the trainer's span ring.
+        """
+        from tpu_dp.obs.spans import percentile
+
+        with self._lock:
+            buckets = dict(sorted(self._bucket_counts.items()))
+            n_batches = self._batch_index
+            lat = sorted(
+                rec["spans"]["total"] for rec in self.recorder.records()
+            )
+            # Under the same lock as record(): a rollup while the dispatch
+            # thread appends would iterate a mutating deque.
+            rollup = self.recorder.rollup()
+        latency = None
+        attainment = None
+        if lat:
+            latency = {
+                "p50_ms": round(percentile(lat, 50), 3),
+                "p95_ms": round(percentile(lat, 95), 3),
+                "p99_ms": round(percentile(lat, 99), 3),
+                "mean_ms": round(sum(lat) / len(lat), 3),
+                "max_ms": round(lat[-1], 3),
+                "n": len(lat),
+            }
+            attainment = round(
+                sum(1 for v in lat if v <= self.slo_ms) / len(lat), 4
+            )
+        snap = self._counters.snapshot()
+        return {
+            "slo": {"target_ms": self.slo_ms, "attainment": attainment},
+            "latency_ms": latency,
+            "spans": {k: v for k, v in rollup.items() if k != "total"},
+            "counters": {k: v for k, v in sorted(snap.items())
+                         if k.startswith("serve.")},
+            "batches": n_batches,
+            "bucket_counts": buckets,
+            "occupancy": snap.get("serve.batch_occupancy"),
+            "retraces": self.retraces,
+            "guards": self.guard_stats(),
+            "device_stats": self.device_stats(),
+            "world": int(self.mesh.devices.size),
+        }
+
+    # -- constructors ----------------------------------------------------
+
+    @classmethod
+    def from_serve_config(cls, model, params, serve_cfg, **kwargs):
+        """Build from a `tpu_dp.config.ServeConfig` section."""
+        from tpu_dp.serve.batcher import parse_buckets
+
+        return cls(
+            model, params,
+            buckets=parse_buckets(serve_cfg.buckets),
+            max_wait_ms=serve_cfg.max_wait_ms,
+            max_queue=serve_cfg.max_queue,
+            slo_ms=serve_cfg.slo_ms,
+            shed_headroom_ms=serve_cfg.shed_headroom_ms,
+            obs_dir=serve_cfg.obs_dir or None,
+            **kwargs,
+        )
+
+    @classmethod
+    def from_checkpoint(cls, ckpt_dir, model=None, mesh=None, **kwargs):
+        """Serve straight from a training checkpoint, params-only.
+
+        ``ckpt_dir`` is either one ``step_*`` checkpoint directory or a
+        `CheckpointManager` root (its newest complete checkpoint is
+        used). The model is rebuilt from the checkpoint's recorded config
+        when not passed. Optimizer state is never materialized
+        (`checkpoint.load_params_only`), so a checkpoint written under
+        any world size or ``train.update_sharding`` mode serves
+        unchanged.
+        """
+        import json
+        from pathlib import Path
+
+        import jax
+
+        from tpu_dp.checkpoint import CheckpointManager, load_params_only
+        from tpu_dp.models import build_model
+
+        ckpt_dir = Path(ckpt_dir)
+        if not (ckpt_dir / "state.msgpack").exists():
+            latest = CheckpointManager(ckpt_dir).latest_dir()
+            if latest is None:
+                raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+            ckpt_dir = latest
+        meta_path = ckpt_dir / "meta.json"
+        meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
+        cfg = meta.get("config", {})
+        if model is None:
+            model_cfg = cfg.get("model", {})
+            name = model_cfg.get("name", "net")
+            num_classes = model_cfg.get("num_classes") or (
+                100 if cfg.get("data", {}).get("dataset") == "cifar100"
+                else 10
+            )
+            model = build_model(name, num_classes=num_classes)
+        image_shape = kwargs.get("image_shape", (32, 32, 3))
+        variables = model.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1,) + tuple(image_shape), np.float32),
+            train=False,
+        )
+        params, batch_stats, _ = load_params_only(
+            ckpt_dir,
+            variables["params"],
+            target_batch_stats=variables.get("batch_stats") or None,
+        )
+        return cls(model, params, batch_stats=batch_stats, mesh=mesh,
+                   **kwargs)

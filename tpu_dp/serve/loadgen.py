@@ -1,0 +1,179 @@
+"""Synthetic load generation: Poisson/burst arrivals + ground-truth audit.
+
+Serving behavior under heavy traffic must be testable on the CPU backend
+(the same 8-virtual-device trick the training tests use), so the load
+generator is deterministic-seeded and keeps its own books: every submit
+outcome (accepted / shed-with-reason) and every handle resolution
+(completed / shed / deadline-missed) is counted caller-side, then compared
+**exactly** against the engine's `tpu_dp.obs` counters. A telemetry number
+that can drift from ground truth is worse than no number — the audit is
+the test (`tests/test_serve.py`, `tools/run_tier1.sh --serve`).
+
+Arrival patterns:
+
+- ``poisson`` — exponential inter-arrival gaps at ``rate_rps`` (the
+  classic open-loop model of independent user traffic);
+- ``burst``   — groups of ``burst`` requests arriving back-to-back,
+  separated by the idle gap that keeps the same average rate (the pattern
+  that actually exercises queue-depth shedding and big buckets).
+
+Requests are "mixed-size": each carries 1..max(sizes) images, drawn from
+``sizes`` — so the dynamic batcher's coalescing and padding both see
+realistic variety.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from tpu_dp.serve.engine import InferenceEngine
+from tpu_dp.serve.queue import ShedError
+
+ARRIVAL_PATTERNS = ("poisson", "burst")
+
+
+def arrival_offsets(n: int, pattern: str, rate_rps: float, burst: int,
+                    rng: np.random.Generator) -> np.ndarray:
+    """Arrival times (seconds from start) for ``n`` requests."""
+    if pattern not in ARRIVAL_PATTERNS:
+        raise ValueError(
+            f"pattern must be one of {ARRIVAL_PATTERNS}, got {pattern!r}"
+        )
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be positive, got {rate_rps}")
+    if n <= 0:
+        return np.zeros((0,))
+    if pattern == "poisson":
+        gaps = rng.exponential(1.0 / rate_rps, size=n)
+        gaps[0] = 0.0
+        return np.cumsum(gaps)
+    # burst: k back-to-back arrivals, then one gap sized to hold the rate.
+    burst = max(1, int(burst))
+    offsets = np.zeros(n)
+    t = 0.0
+    for i in range(n):
+        if i and i % burst == 0:
+            t += burst / rate_rps
+        offsets[i] = t
+    return offsets
+
+
+def run_load(
+    engine: InferenceEngine,
+    n_requests: int = 200,
+    pattern: str = "poisson",
+    rate_rps: float = 400.0,
+    sizes=(1, 2, 3, 4),
+    burst: int = 8,
+    slo_ms: float | None = None,
+    seed: int = 0,
+    wait_timeout_s: float = 60.0,
+) -> dict:
+    """Drive ``engine`` with synthetic traffic; return the audited report.
+
+    The engine must already be started. Returns the engine's `report()`
+    extended with the loadgen's ``ground_truth`` block and
+    ``consistent`` — True iff the engine's serve counters match the
+    caller-side books exactly (accepted, completed, shed total and
+    per-reason, deadline_missed) AND the device-side served count matches
+    the images actually served.
+    """
+    rng = np.random.default_rng(seed)
+    offsets = arrival_offsets(n_requests, pattern, rate_rps, burst, rng)
+    sizes = tuple(int(s) for s in sizes)
+    req_sizes = rng.choice(sizes, size=n_requests)
+    shape = engine.queue.image_shape
+    dtype = engine.queue.image_dtype
+    if np.issubdtype(dtype, np.integer):
+        payloads = [
+            rng.integers(0, 256, size=(k,) + shape).astype(dtype)
+            for k in req_sizes
+        ]
+    else:
+        payloads = [
+            rng.standard_normal((k,) + shape).astype(dtype)
+            for k in req_sizes
+        ]
+
+    before = {
+        k: v for k, v in engine._counters.snapshot().items()
+        if k.startswith("serve.")
+    }
+    served_before = engine.device_stats()["served"]
+
+    handles = []
+    truth = {
+        "submitted": n_requests,
+        "accepted": 0,
+        "shed": 0,
+        "shed_by_reason": {},
+        "completed": 0,
+        "deadline_missed": 0,
+        "images_submitted": int(req_sizes.sum()),
+        "images_served": 0,
+    }
+    t_start = time.perf_counter()
+    for i in range(n_requests):
+        delay = t_start + float(offsets[i]) - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            handles.append((i, engine.submit(payloads[i], slo_ms=slo_ms)))
+            truth["accepted"] += 1
+        except ShedError as e:
+            truth["shed"] += 1
+            truth["shed_by_reason"][e.reason] = (
+                truth["shed_by_reason"].get(e.reason, 0) + 1
+            )
+
+    deadline = time.perf_counter() + wait_timeout_s
+    unresolved = 0
+    for i, h in handles:
+        if not h.wait(max(0.0, deadline - time.perf_counter())):
+            unresolved += 1
+            continue
+        if h.ok:
+            truth["completed"] += 1
+            truth["images_served"] += h.n
+            truth["deadline_missed"] += int(h.deadline_missed)
+        else:
+            truth["shed"] += 1
+            truth["shed_by_reason"][h.shed_reason] = (
+                truth["shed_by_reason"].get(h.shed_reason, 0) + 1
+            )
+    truth["unresolved"] = unresolved
+    wall_s = time.perf_counter() - t_start
+
+    report = engine.report()
+    after = report["counters"]
+
+    def delta(name: str) -> float:
+        return after.get(name, 0.0) - before.get(name, 0.0)
+
+    consistent = (
+        unresolved == 0
+        and delta("serve.accepted") == truth["accepted"]
+        and delta("serve.completed") == truth["completed"]
+        and delta("serve.shed") == truth["shed"]
+        and delta("serve.deadline_missed") == truth["deadline_missed"]
+        and all(
+            delta(f"serve.shed.{reason}") == count
+            for reason, count in truth["shed_by_reason"].items()
+        )
+        and report["device_stats"]["served"] - served_before
+        == truth["images_served"]
+    )
+    report["load"] = {
+        "pattern": pattern,
+        "rate_rps": rate_rps,
+        "sizes": list(sizes),
+        "burst": burst if pattern == "burst" else None,
+        "seed": seed,
+        "wall_s": round(wall_s, 3),
+        "offered_rps": round(n_requests / wall_s, 1) if wall_s else None,
+    }
+    report["ground_truth"] = truth
+    report["consistent"] = bool(consistent)
+    return report

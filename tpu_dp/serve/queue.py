@@ -1,0 +1,303 @@
+"""In-process request queue: bounded depth, deadlines, shed-with-reason.
+
+The admission edge of the serving pipeline (docs/SERVING.md). A request is
+a small batch of images (1..max_batch — "mixed-size" traffic); the queue
+holds it until the dynamic batcher coalesces pending requests into one
+padded bucket. Backpressure is explicit and typed, never silent:
+
+- **bounded depth** — a queue deeper than the engine can drain within the
+  SLO only converts future deadline misses into memory; past ``max_depth``
+  requests, `submit` sheds with reason ``queue_full``;
+- **deadlines** — every request carries an absolute deadline (arrival +
+  its SLO budget). A budget already below ``shed_headroom_ms`` at
+  admission sheds immediately (reason ``deadline``: it cannot possibly be
+  served in time, so rejecting it now is cheaper for everyone than
+  serving it late), and a request that expires while queued is shed at
+  batch-collect time with the same reason;
+- **shed accounting** — every admission and shed increments the
+  process-wide `tpu_dp.obs` counters (``serve.accepted``, ``serve.shed``,
+  ``serve.shed.<reason>``), which the load generator's ground truth must
+  match *exactly* (`tests/test_serve.py`).
+
+Thread-safe: producers call `submit` from any thread; the engine's
+dispatch thread is the single consumer of `collect`/`await_work`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from tpu_dp.obs.counters import Counters, counters as _global_counters
+
+#: shed reasons (the `ShedError.reason` / `RequestHandle.shed_reason` values)
+SHED_QUEUE_FULL = "queue_full"
+SHED_DEADLINE = "deadline"
+SHED_CLOSED = "closed"
+
+
+class ShedError(RuntimeError):
+    """A request was rejected at admission; ``reason`` says why."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued inference request: ``n`` images + its deadline."""
+
+    req_id: int
+    images: np.ndarray          # (n, H, W, C), host-side
+    arrival: float              # time.perf_counter() — the latency clock
+    arrival_ts: float           # time.time() — the obs wall-clock stamp
+    deadline: float             # perf_counter seconds; absolute
+    handle: "RequestHandle"
+
+    @property
+    def n(self) -> int:
+        return int(self.images.shape[0])
+
+
+class RequestHandle:
+    """The caller's half of a request: blocks until served or shed.
+
+    Resolved exactly once by the engine (or by the queue, for requests
+    shed while queued). ``predictions``/``confidence`` are per-image
+    (shape ``(n,)``); ``shed_reason`` is None on success.
+    """
+
+    def __init__(self, req_id: int, n: int):
+        self.req_id = int(req_id)
+        self.n = int(n)
+        self._done = threading.Event()
+        self.predictions: np.ndarray | None = None
+        self.confidence: np.ndarray | None = None
+        self.shed_reason: str | None = None
+        self.latency_ms: float | None = None
+        self.deadline_missed: bool = False
+        self.spans: dict[str, float] = {}
+
+    @property
+    def ok(self) -> bool:
+        return self._done.is_set() and self.shed_reason is None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until resolved; False on timeout."""
+        return self._done.wait(timeout)
+
+    # -- engine-side resolution (exactly once) --------------------------
+
+    def _resolve(self, predictions, confidence, latency_ms,
+                 deadline_missed, spans) -> None:
+        self.predictions = predictions
+        self.confidence = confidence
+        self.latency_ms = float(latency_ms)
+        self.deadline_missed = bool(deadline_missed)
+        self.spans = dict(spans)
+        self._done.set()
+
+    def _shed(self, reason: str) -> None:
+        self.shed_reason = reason
+        self._done.set()
+
+
+class RequestQueue:
+    """Bounded FIFO of pending requests with deadline-aware collection."""
+
+    def __init__(
+        self,
+        max_depth: int = 256,
+        default_slo_ms: float = 50.0,
+        shed_headroom_ms: float = 0.0,
+        image_shape: tuple[int, int, int] = (32, 32, 3),
+        image_dtype=np.uint8,
+        max_request: int | None = None,
+        registry: Counters | None = None,
+    ):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be positive, got {max_depth}")
+        self.max_depth = int(max_depth)
+        self.default_slo_ms = float(default_slo_ms)
+        self.shed_headroom_ms = float(shed_headroom_ms)
+        self.image_shape = tuple(image_shape)
+        # One dtype per queue: the per-bucket programs are compiled for a
+        # fixed input signature, and a request smuggling a different dtype
+        # into a bucket would be a silent retrace (the exact cliff the
+        # ladder exists to prevent).
+        self.image_dtype = np.dtype(image_dtype)
+        # A request larger than the biggest bucket could never be batched
+        # and would wedge the FIFO head forever — a caller error, rejected
+        # at submit (ValueError, not a shed: it is not a load condition).
+        self.max_request = None if max_request is None else int(max_request)
+        self._counters = _global_counters if registry is None else registry
+        self._dq: deque[Request] = deque()
+        self._cond = threading.Condition()
+        self._images = 0          # total images pending (cheap occupancy)
+        self._next_id = 0
+        self._closed = False
+
+    # -- producer side ---------------------------------------------------
+
+    def submit(self, images: np.ndarray, slo_ms: float | None = None,
+               now: float | None = None) -> RequestHandle:
+        """Enqueue one request; raises `ShedError` when load-shed.
+
+        ``images`` is ``(n, H, W, C)`` (a single ``(H, W, C)`` image is
+        promoted to n=1). ``slo_ms`` is this request's latency budget
+        (default: the queue's); the deadline is ``now + slo_ms``.
+        """
+        images = np.asarray(images)
+        if images.shape == self.image_shape:
+            images = images[None]
+        if images.ndim != 4 or images.shape[1:] != self.image_shape:
+            raise ValueError(
+                f"request images must be (n, {', '.join(map(str, self.image_shape))}), "
+                f"got {images.shape}"
+            )
+        if images.dtype != self.image_dtype:
+            raise ValueError(
+                f"request images must be {self.image_dtype}, got "
+                f"{images.dtype} (the bucket programs compile for one "
+                f"fixed input dtype)"
+            )
+        if self.max_request is not None and images.shape[0] > self.max_request:
+            raise ValueError(
+                f"request carries {images.shape[0]} images, above the "
+                f"largest batch bucket ({self.max_request}); split it"
+            )
+        budget_ms = self.default_slo_ms if slo_ms is None else float(slo_ms)
+        now = time.perf_counter() if now is None else float(now)
+        with self._cond:
+            if self._closed:
+                raise ShedError(SHED_CLOSED, "queue is closed")
+            handle = RequestHandle(self._next_id, int(images.shape[0]))
+            self._next_id += 1
+            if len(self._dq) >= self.max_depth:
+                self._counters.inc("serve.shed")
+                self._counters.inc(f"serve.shed.{SHED_QUEUE_FULL}")
+                handle._shed(SHED_QUEUE_FULL)
+                raise ShedError(
+                    SHED_QUEUE_FULL,
+                    f"queue depth {len(self._dq)} at max_depth "
+                    f"{self.max_depth}; request {handle.req_id} shed",
+                )
+            if budget_ms < self.shed_headroom_ms:
+                self._counters.inc("serve.shed")
+                self._counters.inc(f"serve.shed.{SHED_DEADLINE}")
+                handle._shed(SHED_DEADLINE)
+                raise ShedError(
+                    SHED_DEADLINE,
+                    f"deadline budget {budget_ms:.1f}ms below shed headroom "
+                    f"{self.shed_headroom_ms:.1f}ms; request {handle.req_id} "
+                    f"shed at admission",
+                )
+            req = Request(
+                req_id=handle.req_id,
+                images=images,
+                arrival=now,
+                arrival_ts=time.time(),
+                deadline=now + budget_ms / 1e3,
+                handle=handle,
+            )
+            self._dq.append(req)
+            self._images += req.n
+            self._counters.inc("serve.accepted")
+            self._cond.notify_all()
+            return handle
+
+    def close(self) -> None:
+        """Stop admitting; queued requests still drain."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- consumer side (single dispatch thread) --------------------------
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._dq)
+
+    def pending_images(self) -> int:
+        with self._cond:
+            return self._images
+
+    def await_work(self, target_images: int, max_wait_s: float,
+                   timeout_s: float) -> str:
+        """Block until a batch should form; returns why it should.
+
+        - ``"fill"``    — pending images reached ``target_images`` (the
+          ladder's max bucket: no point waiting longer);
+        - ``"wait"``    — the oldest pending request aged past
+          ``max_wait_s`` (or the queue is closed and draining): dispatch
+          what we have;
+        - ``"timeout"`` — no batch became *due* within ``timeout_s``
+          (work may still be pending, just younger than ``max_wait_s`` —
+          the dispatch loop's chance to check its stop flag before
+          waiting again; returning "wait" here instead would silently
+          cap the configured max_wait at the caller's poll interval);
+        - ``"closed"``  — closed AND empty: the drain is complete.
+        """
+        end = time.perf_counter() + timeout_s
+        with self._cond:
+            while True:
+                now = time.perf_counter()
+                if self._dq:
+                    if self._images >= target_images:
+                        return "fill"
+                    oldest = self._dq[0].arrival
+                    if self._closed or now - oldest >= max_wait_s:
+                        return "wait"
+                    if now >= end:
+                        return "timeout"
+                    wake = min(end, oldest + max_wait_s)
+                else:
+                    if self._closed:
+                        return "closed"
+                    if now >= end:
+                        return "timeout"
+                    wake = end
+                self._cond.wait(max(wake - now, 1e-4))
+
+    def collect(self, max_images: int, now: float | None = None
+                ) -> tuple[list[Request], list[Request]]:
+        """Pop (batch, expired): FIFO requests up to ``max_images``.
+
+        Expired requests (deadline already passed — serving them would
+        only produce a late answer nobody is waiting for) are removed
+        wherever they sit in the queue, shed with reason ``deadline``,
+        and returned so the engine can resolve their handles. The batch
+        is then the FIFO prefix whose cumulative image count fits
+        ``max_images`` — a request is never split across batches.
+        """
+        now = time.perf_counter() if now is None else float(now)
+        with self._cond:
+            live: deque[Request] = deque()
+            expired: list[Request] = []
+            for req in self._dq:
+                (expired if req.deadline <= now else live).append(req)
+            batch: list[Request] = []
+            total = 0
+            while live and total + live[0].n <= max_images:
+                req = live.popleft()
+                batch.append(req)
+                total += req.n
+            self._dq = live
+            self._images = sum(r.n for r in live)
+            for req in expired:
+                self._counters.inc("serve.shed")
+                self._counters.inc(f"serve.shed.{SHED_DEADLINE}")
+                req.handle._shed(SHED_DEADLINE)
+            return batch, expired

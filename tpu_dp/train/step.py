@@ -818,6 +818,22 @@ def make_train_step_shard_map(
     )
 
 
+def _infer_forward(model, state: TrainState, batch):
+    """Shared inference forward: normalize → model(train=False) → logits/preds.
+
+    One source of truth for the two inference consumers — `make_eval_step`
+    (training-time accuracy) and `make_serve_step` (the serving subsystem,
+    `tpu_dp/serve/`) — so the serve path can never drift from the forward
+    the eval metrics were measured on. Uses running statistics for
+    BatchNorm models; ``state`` only needs params/batch_stats populated
+    (serve passes a TrainState with an empty opt_state).
+    """
+    images = _maybe_normalize(batch["image"])
+    logits, _ = _apply_model(model, state, images, train=False)
+    predictions = jnp.argmax(logits, axis=-1)
+    return logits, predictions
+
+
 def make_eval_step(model, mesh: Mesh,
                    update_sharding: str = "replicated") -> Callable:
     """Build the jitted eval step: global (correct, count) per batch.
@@ -842,10 +858,9 @@ def make_eval_step(model, mesh: Mesh,
     state_sh = _state_shardings(mesh, update_sharding)
 
     def step(state: TrainState, batch):
-        images, labels = _maybe_normalize(batch["image"]), batch["label"]
+        labels = batch["label"]
         weight = batch.get("weight")
-        logits, _ = _apply_model(model, state, images, train=False)
-        predictions = jnp.argmax(logits, axis=-1)
+        logits, predictions = _infer_forward(model, state, batch)
         if weight is None:
             correct = jnp.sum(predictions == labels)
             count = jnp.asarray(labels.shape[0], jnp.int32)
@@ -862,4 +877,92 @@ def make_eval_step(model, mesh: Mesh,
         step,
         in_shardings=(state_sh, batch_sh),
         out_shardings=repl,
+    )
+
+
+def init_serve_stats(num_classes: int):
+    """Device-resident serving statistics threaded through every serve step.
+
+    ``served`` counts examples actually served (padding excluded via the
+    batch's weight mask) and ``class_counts`` is the per-class prediction
+    histogram — the device-side ground truth `tpu_dp.serve` cross-checks
+    its host-side request counters against. This pytree is the serve
+    step's *donated* argument: like the train state, it is consumed and
+    re-emitted every call, so XLA aliases the buffers in place (dplint
+    DP303 verifies the aliasing for the serve programs too) and the
+    dispatch loop never churns the allocator.
+    """
+    return {
+        "served": jnp.zeros((), jnp.int32),
+        "class_counts": jnp.zeros((int(num_classes),), jnp.int32),
+    }
+
+
+def make_serve_step(model, mesh: Mesh, batch_size: int) -> Callable:
+    """Compiled donated-buffer inference forward for ONE padded bucket size.
+
+    The serving hot path (`tpu_dp/serve/engine.py`) keeps the training
+    stack's compiled-program discipline: every batch the dynamic batcher
+    forms is padded to a fixed bucket size from a ladder, and each bucket
+    gets exactly one program built by this factory — fixed shapes, stats
+    donation, a fingerprinted collective schedule (registered in dplint's
+    Level-3 artifact) — so after one warmup call per bucket the
+    RecompileGuard must observe zero retraces.
+
+    Returns ``step(stats, state, batch) -> (new_stats, out)`` where:
+
+    - ``stats`` is `init_serve_stats`'s pytree, **donated** (argnum 0 —
+      the leading flattened leaves, which is what DP303's prefix check
+      verifies); ``new_stats`` aliases its buffers;
+    - ``state`` is a `TrainState` whose params/batch_stats are populated
+      (opt_state may be empty — serving never materializes it; see
+      `checkpoint.load_params_only`), replicated and NOT donated: it is
+      reused by every call of every bucket program;
+    - ``batch`` is ``{"image": [B, H, W, C], "weight": f32[B]}`` with
+      ``weight`` masking padded rows out of the stats (1.0 = real
+      example), and ``out`` is ``{"prediction": s32[B],
+      "confidence": f32[B]}`` (top-1 class and its softmax probability).
+
+    Replica fan-out comes from the data mesh for free: buckets divisible
+    by the data-axis size shard the batch (and the per-example outputs)
+    over ``data`` — each replica runs B/world examples and the only
+    collectives are the two stats reductions (one scalar, one [C]-vector
+    all-reduce, full-mesh, add — the schedule DP301 holds serve programs
+    to). Smaller buckets run replicated (every device computes the whole
+    batch — duplicated work is cheaper than a resharding collective at
+    those sizes), compiling to zero collectives.
+    """
+    repl = replicated_sharding(mesh)
+    from tpu_dp.parallel.dist import data_axis_size
+
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+    sharded = batch_size % data_axis_size(mesh) == 0
+    batch_sh = batch_sharding(mesh) if sharded else repl
+
+    def step(stats, state: TrainState, batch):
+        logits, predictions = _infer_forward(model, state, batch)
+        weight = batch["weight"]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        confidence = jnp.max(probs, axis=-1)
+        one_hot = jax.nn.one_hot(
+            predictions, logits.shape[-1], dtype=jnp.float32
+        )
+        new_stats = {
+            "served": stats["served"]
+            + jnp.sum(weight).astype(jnp.int32),
+            "class_counts": stats["class_counts"]
+            + jnp.sum(one_hot * weight[:, None], axis=0).astype(jnp.int32),
+        }
+        out = {
+            "prediction": predictions.astype(jnp.int32),
+            "confidence": confidence,
+        }
+        return new_stats, out
+
+    return jax.jit(
+        step,
+        in_shardings=(repl, repl, batch_sh),
+        out_shardings=(repl, batch_sh),
+        donate_argnums=(0,),
     )

@@ -38,7 +38,7 @@ class ThroughputMeter:
         self._last = now
         return now
 
-    def mark(self) -> float:
+    def mark(self, images: int | None = None) -> float:
         """Record 'now' as the end of measured work; returns the fence
         timestamp.
 
@@ -47,9 +47,25 @@ class ThroughputMeter:
         without a fence the rate would be a dispatch rate, not a throughput.
         The returned stamp is the same fence time `tpu_dp.obs` uses as the
         end of a step's ``device`` span — one fence, two consumers.
+
+        ``images`` credits a completed batch *at the fence* — the serving
+        pattern (`tpu_dp.serve`), where batch sizes vary per bucket and
+        work is not back-to-back, so crediting at dispatch (step()'s fixed
+        per-call ``batch_size``) would attribute the wrong bucket's images
+        to the window edges. Mark-credited flow: call ``step(0)`` at each
+        dispatch (advances the warmup window without double-counting) and
+        ``mark(batch_images)`` at each fence; images are counted iff their
+        fence lands inside the open measurement window — including the
+        window-opening step's own batch, whose execution is in-window even
+        though its dispatch stamp *is* the window start.
         """
         now = time.perf_counter()
-        if self._steps > self.warmup_steps:
+        if self._start is None:
+            return now  # window not open: warmup fences are not measured
+        if images and self._steps >= self.warmup_steps:
+            self._images += int(images)
+            self._last = now
+        elif self._steps > self.warmup_steps:
             self._last = now
         return now
 
