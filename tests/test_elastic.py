@@ -1,0 +1,472 @@
+"""Elastic world size: in-process protocol/resplit/hardening tests.
+
+The subprocess end-to-end (3 real processes, rank 2 preempted, survivors
+finish on world 2 — `tests/test_multiprocess.py`) proves the whole loop;
+these tests pin the pieces it is built from, each runnable in-process:
+
+- `elastic_resplit` — the mid-epoch sampler re-split: exact coverage (no
+  drops, no duplicates) across one and two world changes, lockstep step
+  counts, fidelity to what `DataPipeline` actually consumed;
+- `MembershipLedger` — the shared-filesystem protocol, driven by plain
+  threads against one tmp dir: convergence, single-writer plans, timeout
+  departure, exclusive-create races;
+- the `leave:`/`preempt:` fault specs that make regroup testable without
+  external signals;
+- `find_latest`/`resume_latest` hardening against the torn step dirs a
+  crash-mid-snapshot leaves behind;
+- a full single-process `Trainer` departure: `leave:` fault → quiesce →
+  final snapshot with membership lineage → `PreemptedError` (exit-143
+  path), then `--resume=auto` completing bitwise-identically to an
+  uninterrupted run.
+"""
+
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpu_dp.data.sampler import (
+    ElasticTailSampler,
+    ShardedSampler,
+    elastic_resplit,
+)
+from tpu_dp.resilience.elastic import (
+    ElasticError,
+    MembershipLedger,
+    MembershipRecord,
+    QuiescePlan,
+)
+
+pytestmark = pytest.mark.elastic
+
+
+# ---------------------------------------------------------------------------
+# elastic_resplit: the re-split contract
+# ---------------------------------------------------------------------------
+
+
+def _consumed(E, world, steps, per_step, seed=7, epoch=0):
+    """What the pipeline's shards actually consumed: first steps*per_step
+    of every `ShardedSampler` shard stream."""
+    out = []
+    for r in range(world):
+        s = ShardedSampler(E, world, r, shuffle=True, seed=seed)
+        s.set_epoch(epoch)
+        out.append(s.shard_indices()[: steps * per_step])
+    return np.concatenate(out)
+
+
+def test_resplit_exact_coverage_one_hop():
+    E, B = 48, 4
+    consumed = _consumed(E, 3, 2, B)
+    tails = [elastic_resplit(E, True, 7, 0, B, [(3, 2)], 2, m)
+             for m in range(2)]
+    everything = np.concatenate([consumed, *tails])
+    # Every sample of the epoch visited exactly once across the regroup.
+    assert sorted(everything.tolist()) == list(range(E))
+    # Lockstep: every survivor gets the identical step count.
+    assert len(tails[0]) == len(tails[1]) == 12
+
+
+def test_resplit_exact_coverage_two_hops():
+    # 3 ranks for 2 steps, then 2 ranks for 1 step, then world 1.
+    E, B = 48, 4
+    consumed = _consumed(E, 3, 2, B)
+    seg2 = [elastic_resplit(E, True, 7, 0, B, [(3, 2)], 2, m)[:B]
+            for m in range(2)]
+    tail = elastic_resplit(E, True, 7, 0, B, [(3, 2), (2, 1)], 1, 0)
+    everything = np.concatenate([consumed, *seg2, tail])
+    assert sorted(everything.tolist()) == list(range(E))
+
+
+def test_resplit_lockstep_on_awkward_remainders():
+    # Non-divisible everywhere: the split must still hand every survivor
+    # the same whole-step count (unequal counts deadlock the mesh).
+    for E, w0, s0, w1, B in [(50, 3, 1, 2, 4), (47, 3, 2, 2, 4),
+                             (49, 4, 1, 3, 2), (31, 2, 3, 1, 4)]:
+        tails = [elastic_resplit(E, True, 1, 5, B, [(w0, s0)], w1, m)
+                 for m in range(w1)]
+        assert len({len(t) for t in tails}) == 1, (E, w0, s0, w1)
+        assert len(tails[0]) % B == 0
+        # No duplicates within the re-split remainder itself, and nothing
+        # that was already consumed reappears (E divisible: strict).
+        consumed = set(_consumed(E, w0, s0, B, seed=1, epoch=5).tolist())
+        if E % w0 == 0:
+            joined = np.concatenate(tails).tolist()
+            assert len(joined) == len(set(joined))
+            assert not (set(joined) & consumed)
+
+
+def test_resplit_matches_pipeline_consumption(cpu_mesh_1):
+    """The re-split's model of "what was consumed" is bit-for-bit what
+    `DataPipeline` feeds: resume a pipeline mid-epoch via an injected
+    tail sampler and the union equals the uninterrupted epoch."""
+    from tpu_dp.data.cifar import make_synthetic
+    from tpu_dp.data.pipeline import DataPipeline
+
+    ds = make_synthetic(48, 10, seed=0, name="resplit")
+    pipe = DataPipeline(ds, batch_size=4, mesh=cpu_mesh_1, shuffle=True,
+                        seed=7, prefetch=0)
+    pipe.set_epoch(0)
+    full = [np.asarray(b["label"]) for b in pipe]
+    # Re-split after 2 of the 12 steps onto "world 1" (same process).
+    idx = elastic_resplit(48, True, 7, 0, 4, [(1, 2)], 1, 0)
+    tail_pipe = DataPipeline(ds, batch_size=4, mesh=cpu_mesh_1, shuffle=True,
+                             seed=7, prefetch=0,
+                             sampler=ElasticTailSampler(idx, 0))
+    tail_pipe.set_epoch(0)
+    tail = [np.asarray(b["label"]) for b in tail_pipe]
+    np.testing.assert_array_equal(
+        np.concatenate(full[:2] + tail), np.concatenate(full)
+    )
+
+
+def test_resplit_non_divisible_matches_uninterrupted_plan():
+    """Fidelity on non-divisible sizes: the live sampler pads by
+    wraparound (torch `DistributedSampler` parity — `DataPipeline` builds
+    it with sampler-level drop_remainder=False regardless of its own step
+    truncation), and the re-split reproduces that pad bit-for-bit. The
+    interrupted epoch consumes the same NUMBER of samples as the
+    uninterrupted plan with no sample exceeding its padded-stream count
+    (nothing replayed, nothing invented); at the step-truncation seam the
+    identity of the shed leftovers may swap — the same drop_remainder
+    freedom every epoch end already has — bounded by one global batch."""
+    from collections import Counter
+
+    E, B, world = 51, 4, 2  # 51 % 2 != 0: one wraparound-pad duplicate
+    plan = []  # the uninterrupted epoch's consumption, per live sampler
+    padded = []
+    for r in range(world):
+        s = ShardedSampler(E, world, r, shuffle=True, seed=3)
+        s.set_epoch(1)
+        stream = s.shard_indices()
+        padded.append(stream)
+        plan.append(stream[: (len(stream) // B) * B])  # 6 whole steps
+    consumed = _consumed(E, world, 3, B, seed=3, epoch=1)  # 3 steps ran
+    tails = [elastic_resplit(E, True, 3, 1, B, [(world, 3)], world, m)
+             for m in range(world)]
+    got = Counter(np.concatenate([consumed, *tails]).tolist())
+    want = Counter(np.concatenate(plan).tolist())
+    assert sum(got.values()) == sum(want.values())  # same consumption count
+    stream_counts = Counter(np.concatenate(padded).tolist())
+    for sample, n in got.items():
+        assert n <= stream_counts[sample], f"sample {sample} over-consumed"
+    # Seam freedom: the swapped leftovers stay under one global batch.
+    swapped = sum(((want - got) + (got - want)).values())
+    assert swapped < 2 * world * B, swapped
+
+
+def test_tail_sampler_refuses_reseed():
+    s = ElasticTailSampler(np.arange(8), epoch=3)
+    s.set_epoch(3)  # idempotent
+    with pytest.raises(ValueError, match="pinned to epoch 3"):
+        s.set_epoch(4)
+
+
+def test_resplit_rejects_bad_lineage():
+    with pytest.raises(ValueError, match="consumes"):
+        elastic_resplit(16, True, 0, 0, 4, [(2, 99)], 1, 0)
+    with pytest.raises(ValueError, match="out of range"):
+        elastic_resplit(16, True, 0, 0, 4, [], 2, 5)
+
+
+# ---------------------------------------------------------------------------
+# MembershipLedger: the file protocol, exercised by real threads
+# ---------------------------------------------------------------------------
+
+
+def _converge(ledger: MembershipLedger, members, step0: int,
+              leaving: bool, deadline_s: float = 20.0) -> QuiescePlan:
+    """Drive one member's quiesce loop the way the trainer does: refresh
+    the check-in (advancing its step, as a live rank would), try to
+    publish, poll for the plan."""
+    start = time.monotonic()
+    step = step0
+    while time.monotonic() - start < deadline_s:
+        ledger.check_in(1, step, leaving, "graceful", window=1)
+        plan = ledger.try_plan(1)
+        if plan is None:
+            ledger.maybe_publish_plan(
+                1, members, train_epoch=0,
+                timed_out=time.monotonic() - start > 2.0,
+            )
+            plan = ledger.try_plan(1)
+        if plan is not None:
+            return plan
+        step += 1
+        time.sleep(0.01)
+    raise AssertionError("no plan within deadline")
+
+
+def test_ledger_graceful_convergence_threads(tmp_path):
+    members = [0, 1, 2]
+    plans = {}
+
+    def member(sid):
+        led = MembershipLedger(tmp_path, sid)
+        plans[sid] = _converge(led, members, step0=4 + sid, leaving=sid == 2)
+
+    threads = [threading.Thread(target=member, args=(s,)) for s in members]
+    [t.start() for t in threads]
+    [t.join(timeout=30) for t in threads]
+    assert set(plans) == {0, 1, 2}
+    # Everyone adopted the ONE canonical plan.
+    assert len({json.dumps(p.to_json(), sort_keys=True)
+                for p in plans.values()}) == 1
+    plan = plans[0]
+    assert plan.flavor == "graceful"
+    assert plan.leavers == (2,)
+    assert plan.survivors == (0, 1)
+    assert plan.departed == ()
+    # The stop threshold clears every member's published position.
+    assert plan.stop_step > max(4 + s for s in members)
+
+
+def test_ledger_timeout_declares_departed(tmp_path):
+    # Member 2 never checks in (hard death): the collection times out and
+    # the plan demotes it to departed with a rollback flavor.
+    members = [0, 1, 2]
+    plans = {}
+
+    def member(sid):
+        led = MembershipLedger(tmp_path, sid)
+        plans[sid] = _converge(led, members, step0=3, leaving=False)
+
+    threads = [threading.Thread(target=member, args=(s,)) for s in (0, 1)]
+    [t.start() for t in threads]
+    [t.join(timeout=30) for t in threads]
+    plan = plans[0]
+    assert plans[1].to_json() == plan.to_json()
+    assert plan.flavor == "rollback"
+    assert [d["sid"] for d in plan.departed] == [2]
+    assert "no quiesce check-in" in plan.departed[0]["reason"]
+    assert plan.survivors == (0, 1)
+
+
+def test_ledger_suspect_reason_lands_in_plan(tmp_path):
+    led0 = MembershipLedger(tmp_path, 0)
+    led0.mark_suspect(1, 1, "heartbeat stale 42s")
+    led0.check_in(1, 7, leaving=False, flavor="rollback")
+    led0.maybe_publish_plan(1, [0, 1], train_epoch=0, timed_out=True)
+    plan = led0.try_plan(1)
+    assert plan.flavor == "rollback"
+    assert plan.departed == ({"sid": 1, "reason": "heartbeat stale 42s"},)
+    # Epoch-scoped accusation: the same file is inert for the NEXT
+    # transition (a surviving false-positive must not re-trigger regroups
+    # of every later epoch).
+    assert led0.suspects(2) == {}
+    assert led0.suspects(1) == {1: "heartbeat stale 42s"}
+
+
+def test_ledger_plan_publish_is_exclusive(tmp_path):
+    # Two racing publishers: exactly one plan file wins; the loser adopts.
+    from tpu_dp.resilience.elastic import _exclusive_write_json
+
+    path = tmp_path / "plan_e0001.json"
+    a = _exclusive_write_json(path, {"who": "a"})
+    b = _exclusive_write_json(path, {"who": "b"})
+    assert a and not b
+    assert json.loads(path.read_text()) == {"who": "a"}
+
+
+def test_membership_record_roundtrip_and_epoch_await(tmp_path):
+    led = MembershipLedger(tmp_path, 0)
+    rec = led.write_initial([0, 1, 2], "127.0.0.1:9999")
+    assert rec.epoch == 0 and rec.world == 3
+    assert rec.rank_of(1) == 1
+    nxt = MembershipRecord(
+        epoch=1, members=(0, 2), coordinator="127.0.0.1:10000",
+        departed=({"sid": 1, "reason": "preempted"},),
+        resume={"epoch": 0, "steps_done": 4, "lineage": [[3, 4]],
+                "global_step": 4, "snapshot_dir": "snap"},
+        reason="graceful", ts=123.0,
+    )
+    led.publish_epoch(nxt)
+    got = led.await_epoch(1, timeout_s=2)
+    assert got.members == (0, 2)
+    assert got.rank_of(2) == 1  # dense ranks reassigned, sids stable
+    with pytest.raises(ElasticError, match="not a member"):
+        got.rank_of(1)
+    assert led.current().epoch == 1
+    with pytest.raises(ElasticError, match="did not appear"):
+        led.await_epoch(5, timeout_s=0.2)
+
+
+def test_quiesce_ack_barrier(tmp_path):
+    led0, led1 = MembershipLedger(tmp_path, 0), MembershipLedger(tmp_path, 1)
+    led0.ack_quiesced(1)
+    assert led0.await_quiesced(1, [0, 1], timeout_s=0.3) == [1]  # 1 missing
+    led1.ack_quiesced(1)
+    assert led0.await_quiesced(1, [0, 1], timeout_s=2) == []
+
+
+# ---------------------------------------------------------------------------
+# fault injection: the signal-free elastic specs
+# ---------------------------------------------------------------------------
+
+
+def test_faultinject_leave_and_rank_gated_preempt():
+    from tpu_dp.resilience import FaultInjector, FaultPlan
+
+    plan = FaultPlan.parse("leave:step=4,rank=2")
+    assert (plan.kind, plan.step, plan.rank) == ("leave", 4, 2)
+    # Rank-gated: only the targeted rank's injector fires.
+    bystander = FaultInjector(plan, rank=0)
+    bystander.on_step(9)
+    assert not bystander.leave_requested and not bystander.fired
+    target = FaultInjector(plan, rank=2)
+    target.on_step(3)
+    assert not target.leave_requested
+    target.on_step(4)
+    assert target.leave_requested and target.fired
+    # `preempt:rank=R` parses the same gating (the SIGTERM twin).
+    p2 = FaultPlan.parse("preempt:rank=2,step=9")
+    assert (p2.kind, p2.rank, p2.step) == ("preempt", 2, 9)
+
+
+# ---------------------------------------------------------------------------
+# resume hardening: torn step dirs must not fail the regroup
+# ---------------------------------------------------------------------------
+
+
+def _fake_save(dir_path: Path, payload: bytes = b"x"):
+    dir_path.mkdir(parents=True)
+    (dir_path / "state.msgpack").write_bytes(payload)
+    (dir_path / "meta.json").write_text("{}")
+
+
+def test_find_latest_skips_partial_step_dir(tmp_path, caplog):
+    from tpu_dp.resilience import find_candidates, find_latest
+
+    snaps = tmp_path / "snapshots"
+    _fake_save(snaps / "step_0000000010")
+    # The crash-mid-snapshot signature: state landed, meta never did.
+    torn = snaps / "step_0000000020"
+    torn.mkdir(parents=True)
+    (torn / "state.msgpack").write_bytes(b"y")
+    found = find_latest(tmp_path / "none", snaps)
+    assert found is not None and found[0].name == "step_0000000010"
+    # ... even when the `latest` pointer names the torn dir.
+    (snaps / "latest").write_text("step_0000000020")
+    assert find_latest(tmp_path / "none", snaps)[0].name == "step_0000000010"
+    assert [d.name for d, _ in find_candidates(tmp_path / "none", snaps)] == [
+        "step_0000000010"
+    ]
+
+
+def test_resume_latest_falls_back_past_corrupt_payload(tmp_path, cpu_mesh_1):
+    import jax
+
+    from tpu_dp import checkpoint as ckpt_lib
+    from tpu_dp.models import Net
+    from tpu_dp.resilience import resume_latest
+    from tpu_dp.train import SGD, create_train_state
+
+    state = create_train_state(Net(), jax.random.PRNGKey(0),
+                               np.zeros((1, 32, 32, 3), np.float32), SGD(0.9))
+    snaps = tmp_path / "snapshots"
+    ckpt_lib.CheckpointManager(snaps, async_save=False).save(
+        state, {"kind": "snapshot", "epoch": 0, "steps_done": 1}, step=5
+    )
+    # A newer save whose payload was truncated by the dying host — both
+    # files exist, so only the msgpack parse can reveal the tear.
+    _fake_save(snaps / "step_0000000009", payload=b"\x00truncated")
+    restored, meta, source = resume_latest(state, tmp_path / "none", snaps)
+    assert source.name == "step_0000000005"
+    assert meta["steps_done"] == 1
+    with pytest.raises(FileNotFoundError):
+        resume_latest(state, tmp_path / "empty")
+
+
+# ---------------------------------------------------------------------------
+# Trainer: single-process departure + resume (the exit-143 contract)
+# ---------------------------------------------------------------------------
+
+
+def _elastic_cfg(tmp_path, **over):
+    from tpu_dp.config import Config
+
+    cfg = Config()
+    cfg.data.dataset = "synthetic"
+    cfg.data.synthetic_train_size = 48
+    cfg.data.synthetic_test_size = 16
+    cfg.data.batch_size = 4
+    cfg.train.epochs = 2
+    cfg.train.log_every = 100
+    cfg.train.eval_at_end = False
+    cfg.train.steps_per_call = 1
+    cfg.train.ckpt_dir = str(tmp_path / "ck")
+    cfg.train.ckpt_async = False
+    cfg.parallel.num_devices = 1  # the conftest mesh is 8 virtual devices
+    cfg.resilience.elastic = True
+    for key, val in over.items():
+        cfg.override(key, str(val))
+    return cfg
+
+
+def test_trainer_elastic_requires_drop_remainder(tmp_path):
+    from tpu_dp.train.trainer import Trainer
+
+    cfg = _elastic_cfg(tmp_path)
+    cfg.data.drop_remainder = False
+    with pytest.raises(ValueError, match="drop_remainder"):
+        Trainer(cfg)
+
+
+@pytest.mark.resilience
+def test_trainer_leave_fault_departs_with_membership_manifest(tmp_path):
+    from tpu_dp.resilience import PreemptedError
+    from tpu_dp.train.trainer import Trainer
+
+    cfg = _elastic_cfg(tmp_path, **{"resilience.fault": "leave:step=3"})
+    tr = Trainer(cfg)
+    with pytest.raises(PreemptedError, match="elastic departure"):
+        tr.fit()
+    # The ledger recorded the whole transition...
+    gen_dirs = list((tmp_path / "ck" / "membership").iterdir())
+    assert len(gen_dirs) == 1
+    names = {p.name for p in gen_dirs[0].iterdir()}
+    assert {"epoch_0000.json", "plan_e0001.json", "left_r00000.json",
+            "q_e0001_r00000.json", "q_e0001_r00000.done"} <= names
+    plan = json.loads((gen_dirs[0] / "plan_e0001.json").read_text())
+    assert plan["leavers"] == [0] and plan["survivors"] == []
+    # ... and the final snapshot carries the membership lineage the next
+    # incarnation (or a survivor regroup) re-splits from.
+    snap_meta = json.loads(
+        (Path(tr.snapshot_dir) / f"step_{plan['stop_step']:010d}"
+         / "meta.json").read_text()
+    )
+    assert snap_meta["kind"] == "snapshot"
+    assert snap_meta["membership"]["lineage"] == [[1, plan["stop_step"]]]
+    assert snap_meta["membership"]["members"] == [0]
+
+
+@pytest.mark.resilience
+def test_trainer_leave_then_auto_resume_bitwise_identical(tmp_path):
+    import jax
+
+    from tpu_dp.resilience import PreemptedError
+    from tpu_dp.train.trainer import Trainer
+
+    cfg = _elastic_cfg(tmp_path, **{"resilience.fault": "leave:step=3"})
+    with pytest.raises(PreemptedError):
+        Trainer(cfg).fit()
+    resumed = Trainer(_elastic_cfg(tmp_path, **{"train.resume": "true"}))
+    resumed.fit()
+
+    ref = Trainer(_elastic_cfg(tmp_path / "ref"))
+    ref.fit()
+    for a, b in zip(jax.tree_util.tree_leaves(resumed.state),
+                    jax.tree_util.tree_leaves(ref.state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.fixture
+def cpu_mesh_1():
+    from tpu_dp.parallel import dist
+
+    return dist.data_mesh(num_devices=1)

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Elastic kill-one-rank smoke: 3 CPU processes, rank 2 preempted, the
+survivors finish on world 2 — the `tools/run_tier1.sh --elastic` lane.
+
+Spawns three `train.py`-equivalent workers (Trainer driven directly, gloo
+CPU collectives), delivers a deterministic SIGTERM to rank 2 at step 2 via
+``TPU_DP_FAULT=preempt:``, and verdicts the run:
+
+- rank 2 exits 143 (terminated-by-request), ranks 0/1 exit 0 — no
+  operator action;
+- the membership ledger records epoch 1 with rank 2 departed;
+- the survivors' final params are bit-identical to each other;
+- the regroup is attributed in the obs counters.
+
+Archives the membership ledger directory and a regroup report under
+``artifacts/elastic/`` (the CI artifacts reviewers diff). Exit 0 on a
+clean regroup, 1 on any violated check.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+_WORKER = r"""
+import os, pickle, sys
+rank = int(sys.argv[1]); port = sys.argv[2]; ckpt = sys.argv[3]
+out_path = sys.argv[4]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from tpu_dp.config import Config
+from tpu_dp.train.trainer import Trainer
+from tpu_dp.resilience import PreemptedError
+
+cfg = Config()
+cfg.data.dataset = "synthetic"
+cfg.data.synthetic_train_size = 48
+cfg.data.synthetic_test_size = 16
+cfg.data.batch_size = 4
+cfg.train.epochs = 2
+cfg.train.log_every = 100
+cfg.train.eval_at_end = False
+cfg.train.steps_per_call = 1
+cfg.train.ckpt_dir = ckpt
+cfg.train.ckpt_async = False
+cfg.train.obs = "basic"
+cfg.resilience.elastic = True
+cfg.resilience.fault = "preempt:step=2,rank=2"
+cfg.parallel.coordinator_address = f"127.0.0.1:{port}"
+cfg.parallel.num_processes = 3
+cfg.parallel.process_id = rank
+
+tr = Trainer(cfg)
+try:
+    tr.fit()
+except PreemptedError:
+    sys.exit(143)
+from tpu_dp.obs.counters import counters
+digest = float(sum(
+    np.abs(np.asarray(l)).sum()
+    for l in jax.tree_util.tree_leaves(tr.state.params)))
+with open(out_path, "wb") as f:
+    pickle.dump(dict(rank=rank, world=tr.ctx.process_count,
+                     new_rank=tr.ctx.process_index, digest=digest,
+                     record=tr.elastic.record.to_json(),
+                     counters=counters.snapshot()), f)
+sys.exit(0)
+"""
+
+
+def main() -> int:
+    art = REPO / "artifacts" / "elastic"
+    art.mkdir(parents=True, exist_ok=True)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    tmp = Path(tempfile.mkdtemp(prefix="tpu_dp_elastic_smoke."))
+    script = tmp / "worker.py"
+    script.write_text(_WORKER)
+    ckpt = tmp / "ck"
+    outs = [tmp / f"out{r}.pkl" for r in range(3)]
+    import os
+
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    env.pop("TPU_DP_FAULT", None)
+    t0 = time.time()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r), port, str(ckpt), str(outs[r])],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for r in range(3)
+    ]
+    logs = []
+    try:
+        for p in procs:
+            logs.append(p.communicate(timeout=300)[0].decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        print("FAIL: elastic smoke timed out", file=sys.stderr)
+        for i, log in enumerate(logs):
+            print(f"--- rank {i}\n{log[-2000:]}", file=sys.stderr)
+        return 1
+
+    failures: list[str] = []
+    want = {0: 0, 1: 0, 2: 143}
+    for r, p in enumerate(procs):
+        if p.returncode != want[r]:
+            failures.append(f"rank {r}: exit {p.returncode} != {want[r]}")
+    results = {}
+    for r in (0, 1):
+        if outs[r].exists():
+            results[r] = pickle.loads(outs[r].read_bytes())
+        else:
+            failures.append(f"rank {r}: no result dump")
+    record = None
+    if len(results) == 2:
+        a, b = results[0], results[1]
+        record = a["record"]
+        if a["world"] != 2 or b["world"] != 2:
+            failures.append(f"survivor world {a['world']}/{b['world']} != 2")
+        if record["epoch"] != 1 or record["members"] != [0, 1]:
+            failures.append(f"membership record wrong: {record}")
+        if [d["sid"] for d in record["departed"]] != [2]:
+            failures.append(f"departed wrong: {record['departed']}")
+        if a["digest"] != b["digest"]:
+            failures.append(
+                f"survivor params diverged: {a['digest']} != {b['digest']}")
+        for r in (0, 1):
+            c = results[r]["counters"]
+            if c.get("elastic.regroups") != 1 or c.get("elastic.lost_ranks") != 1:
+                failures.append(f"rank {r}: regroup counters wrong: "
+                                f"{ {k: v for k, v in c.items() if k.startswith('elastic')} }")
+
+    # Archive: the membership ledger + the verdict report.
+    mem_root = ckpt / "membership"
+    gen_dirs = sorted(mem_root.iterdir()) if mem_root.exists() else []
+    ledger_art = art / "membership"
+    if ledger_art.exists():
+        shutil.rmtree(ledger_art)
+    if gen_dirs:
+        shutil.copytree(gen_dirs[-1], ledger_art)
+    report = {
+        "ok": not failures,
+        "failures": failures,
+        "wall_s": round(time.time() - t0, 1),
+        "exit_codes": [p.returncode for p in procs],
+        "membership_record": record,
+        "counters": {r: {k: v for k, v in results[r]["counters"].items()
+                         if k.startswith("elastic")}
+                     for r in results},
+    }
+    (art / "regroup_report.json").write_text(json.dumps(report, indent=2))
+    print(f"elastic smoke: {'OK' if not failures else 'FAIL'} "
+          f"({report['wall_s']}s) — artifacts/elastic/regroup_report.json")
+    if failures:
+        for f in failures:
+            print(f"  FAIL: {f}", file=sys.stderr)
+        for i, log in enumerate(logs):
+            print(f"--- rank {i}\n{log[-2000:]}", file=sys.stderr)
+        return 1
+    shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

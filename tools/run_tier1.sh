@@ -20,6 +20,16 @@
 #                                    # smoke run (archives its metrics.jsonl
 #                                    # and Perfetto trace under artifacts/)
 #                                    # + the -m obs tests.
+#   tools/run_tier1.sh --elastic     # elastic world-size lane: the
+#                                    # kill-one-rank smoke (3 CPU
+#                                    # processes, rank 2 preempted at
+#                                    # step 2, survivors finish on
+#                                    # world 2; archives the membership
+#                                    # ledger + regroup report under
+#                                    # artifacts/elastic/) + the
+#                                    # -m elastic tests (protocol units
+#                                    # AND the 3-process subprocess
+#                                    # suite).
 #   tools/run_tier1.sh --serve       # serving lane: a 200-request mixed-
 #                                    # size synthetic load through the full
 #                                    # queue → batcher → compiled-forward
@@ -81,6 +91,17 @@ if [ "${1:-}" = "--obs" ]; then
     rm -rf "$SMOKE"
     echo "obs smoke: artifacts/metrics.jsonl + artifacts/trace.perfetto.json"
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--elastic" ]; then
+    # The smoke is its own verdict (exit 1 when any survivor check
+    # fails); the archived membership ledger + regroup report are the CI
+    # record of the shrink. Then the full elastic suite, subprocess tests
+    # included.
+    mkdir -p artifacts
+    env JAX_PLATFORMS=cpu python tools/elastic_smoke.py || exit $?
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m elastic \
         -p no:cacheprovider
 fi
 

@@ -54,6 +54,21 @@ def _to_host(tree):
     return jax.tree_util.tree_map(leaf_to_host, tree)
 
 
+def has_cross_process_leaves(tree) -> bool:
+    """True when materializing ``tree`` on host is a COLLECTIVE operation.
+
+    A leaf sharded across processes (sharded-update optimizer state on a
+    multi-host mesh) assembles via `leaf_to_host`'s across-host allgather —
+    every process must walk the tree in the same order, or the writer
+    deadlocks waiting for peers that already bailed behind a rank gate.
+    The write gates below consult this before returning early.
+    """
+    return any(
+        not getattr(x, "is_fully_addressable", True)
+        for x in jax.tree_util.tree_leaves(tree)
+    )
+
+
 def _atomic_write_state(
     ckpt_dir: Path, host_state, meta: dict[str, Any] | None
 ) -> Path:
@@ -74,10 +89,19 @@ def save_checkpoint(
     state: TrainState,
     meta: dict[str, Any] | None = None,
 ) -> Path | None:
-    """Write state + metadata; process 0 only. Returns the path (rank 0)."""
+    """Write state + metadata; process 0 only. Returns the path (rank 0).
+
+    With cross-process-sharded leaves the host materialization is itself a
+    collective, so every process runs it; only the write is rank-gated.
+    """
+    host_state = None
+    if has_cross_process_leaves(state):
+        host_state = _to_host(state)  # all processes participate
     if jax.process_index() != 0:  # dplint: allow(DP101) host-only IO
         return None
-    return _atomic_write_state(Path(ckpt_dir), _to_host(state), meta)
+    if host_state is None:
+        host_state = _to_host(state)
+    return _atomic_write_state(Path(ckpt_dir), host_state, meta)
 
 
 def _relayout_opt_leaf(saved: np.ndarray, like: np.ndarray,
@@ -231,7 +255,13 @@ class CheckpointManager:
         copy of ``state`` (the resilience snapshot layer's double buffer)
         instead of paying a fresh device→host copy + allocation here; the
         buffer must stay untouched until the next ``save``/``wait``.
+
+        Cross-process-sharded leaves make the host materialization a
+        collective (`has_cross_process_leaves`): every process assembles,
+        only process 0 keeps the result and writes.
         """
+        if host_state is None and has_cross_process_leaves(state):
+            host_state = _to_host(state)  # all processes participate
         if jax.process_index() != 0:  # dplint: allow(DP101) host-only IO
             return None
         self.wait()
@@ -269,19 +299,43 @@ class CheckpointManager:
             _write()
         return step_dir / _CKPT_NAME
 
+    def complete_dirs(self) -> list[Path]:
+        """Every step dir holding a complete save, oldest→newest.
+
+        A complete save always has both files; a torn write (a crash
+        between the two renames — e.g. a host dying mid-snapshot during
+        preemption) must never be resumed from, so partial dirs are
+        excluded here and the elastic-regroup/resume paths fall back to
+        the previous complete one (`tpu_dp.resilience.find_latest`).
+        """
+        return [
+            d for d in self._step_dirs()
+            if (d / _CKPT_NAME).exists() and (d / _META_NAME).exists()
+        ]
+
     def latest_dir(self) -> Path | None:
         """Directory of the newest complete checkpoint, or None."""
         ptr = self.ckpt_dir / "latest"
         if ptr.exists():
-            cand = self.ckpt_dir / ptr.read_text().strip()
-            if (cand / _CKPT_NAME).exists():
+            name = ptr.read_text().strip()
+            cand = self.ckpt_dir / name
+            # The pointer is only trusted when it names a COMPLETE save —
+            # both files. (`latest` is written after the step dir, so this
+            # should be impossible; a crash-interrupted filesystem can
+            # still produce it — torn dir or a zero-byte pointer — and
+            # resuming a torn dir would fail the regroup it exists to
+            # serve.)
+            if name and (cand / _CKPT_NAME).exists() \
+                    and (cand / _META_NAME).exists():
                 return cand
-        # A complete save always has both files; a torn write (crash between
-        # the two renames) must never be resumed from.
-        dirs = [
-            d for d in self._step_dirs()
-            if (d / _CKPT_NAME).exists() and (d / _META_NAME).exists()
-        ]
+            if name:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "checkpoint pointer %s names incomplete dir %s; "
+                    "falling back to the newest complete save", ptr, cand,
+                )
+        dirs = self.complete_dirs()
         return dirs[-1] if dirs else None
 
     def restore(self, target: TrainState) -> tuple[TrainState, dict[str, Any]]:

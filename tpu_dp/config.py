@@ -170,6 +170,29 @@ class ResilienceConfig:
     # Deterministic fault injection spec (testing only; see
     # tpu_dp/resilience/faultinject.py), e.g. "kill:step=13,rank=1".
     fault: str = ""
+    # Elastic world size (tpu_dp/resilience/elastic.py, docs/RESILIENCE.md
+    # "Elastic world size"): a preempted rank triggers a regroup onto the
+    # survivors (shrink the mesh, reshard, re-split the epoch) instead of
+    # ending the run. Requires data.drop_remainder and a shared filesystem
+    # under train.ckpt_dir. SIGTERM then means "THIS rank leaves" rather
+    # than "the whole job exits".
+    elastic: bool = False
+    # Membership-ledger directory ("" = <train.ckpt_dir>/membership).
+    membership_dir: str = ""
+    # Bound on every regroup phase (quiesce collection, epoch-record wait,
+    # re-bootstrap): a member silent past this is declared departed.
+    regroup_timeout_s: float = 60.0
+    # Ledger-poll cadence in optimizer steps (crossing discipline, like
+    # snapshots): how often a window boundary globs the membership dir.
+    elastic_poll_every_steps: int = 1
+    # Refuse to regroup below this world size (survivors raise instead).
+    elastic_min_world: int = 1
+    # Host the new leader advertises for the regrouped coordinator
+    # ("" = keep loopback on single-host topologies, else hostname).
+    elastic_coordinator_host: str = ""
+    # Re-verify the DP304 collective-schedule fingerprint on the shrunk
+    # mesh before the first post-regroup step (one AOT compile per regroup).
+    elastic_verify_fingerprint: bool = True
 
 
 @dataclass

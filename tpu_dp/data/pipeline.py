@@ -59,6 +59,7 @@ class DataPipeline:
         drop_remainder: bool = True,
         prefetch: int = 2,
         accum_steps: int = 1,
+        sampler=None,
     ):
         self.dataset = dataset
         self.batch_size = int(batch_size)
@@ -76,7 +77,12 @@ class DataPipeline:
             # carries no weight mask); a wraparound-padded final stack would
             # silently give duplicated examples full gradient weight.
             raise ValueError("accum_steps > 1 requires drop_remainder=True")
-        self.sampler = ShardedSampler(
+        # An injected sampler overrides the epoch-permutation default: the
+        # elastic-regroup path feeds an `ElasticTailSampler` carrying the
+        # re-split remainder of an interrupted epoch
+        # (`tpu_dp.data.sampler.elastic_resplit`) — same iteration
+        # machinery, explicit index stream.
+        self.sampler = sampler if sampler is not None else ShardedSampler(
             len(dataset),
             num_shards=jax.process_count(),
             shard_id=jax.process_index(),

@@ -80,3 +80,125 @@ class ShardedSampler:
                     [indices] + [indices] * reps
                 )[:total]
         return indices[self.shard_id :: self.num_shards]
+
+
+def elastic_resplit(
+    num_examples: int,
+    shuffle: bool,
+    seed: int,
+    epoch: int,
+    per_step: int,
+    lineage: "list[tuple[int, int]] | list[list[int]]",
+    new_world: int,
+    new_shard_id: int,
+) -> np.ndarray:
+    """Re-split an interrupted epoch's *remaining* samples over survivors.
+
+    The elastic-regroup half of the `DistributedSampler` contract
+    (`tpu_dp.resilience.elastic`, docs/RESILIENCE.md "Elastic world
+    size"): after a mid-epoch world change, every sample of the epoch that
+    has **not** been consumed yet must be visited exactly once on the new
+    world — no drops, no duplicates — and every survivor must compute the
+    same answer with zero communication.
+
+    "Exactly once" is relative to the epoch's consumption *plan*: with
+    ``num_examples % world != 0`` the live pipeline's `ShardedSampler`
+    pads by wraparound (torch `DistributedSampler` parity — a few
+    duplicated samples per epoch), and the re-split reproduces that pad
+    bit-for-bit — nothing is replayed and nothing invented. At the
+    step-truncation seam the *identity* of the shed leftovers may differ
+    from the uninterrupted run's (the same ``drop_remainder`` freedom
+    every epoch end already exercises), bounded by one global batch; with
+    divisible sizes the match is exact.
+
+    ``lineage`` is the epoch's consumption history so far, a sequence of
+    ``(world, steps)`` segments: the epoch ran ``steps_0`` optimizer steps
+    sharded over ``world_0`` processes, then (after a regroup)
+    ``steps_1`` over ``world_1``, … Each segment consumes
+    ``steps * per_step`` indices from every one of its shards
+    (``per_step`` = per-process batch × grad-accum microbatches — constant
+    across regroups; the *global* batch is what shrinks). Replaying the
+    lineage is pure arithmetic over the epoch's seeded permutation, so a
+    third regroup (or a restart resuming into a re-split tail) reconstructs
+    the exact remaining set from ``(seed, epoch, lineage)`` alone.
+
+    Construction, per segment: pad the current remaining stream by
+    wraparound to a multiple of the segment's world and shard it
+    round-robin (``stream[r::world]`` — bit-for-bit `ShardedSampler`'s own
+    layout for segment 0, wraparound pad included), drop each shard's
+    first ``steps*per_step`` (consumed), then re-concatenate the shard
+    tails in rank order as the next segment's remaining stream. Strided
+    splits partition, so the invariant "consumed ⊎ remaining = epoch set"
+    survives every hop. Returns the ``new_shard_id``-th strided shard of
+    the final remaining stream, truncated so **every** survivor gets the
+    same whole-step count (the lockstep requirement; the ≤
+    ``new_world × per_step − 1`` seam samples this can shed are the same
+    `drop_remainder` policy every epoch end already applies — with
+    divisible sizes, exactness is total).
+    """
+    if not 0 <= new_shard_id < new_world:
+        raise ValueError(
+            f"new_shard_id {new_shard_id} out of range for world {new_world}"
+        )
+    base = ShardedSampler(
+        int(num_examples), num_shards=1, shard_id=0,
+        shuffle=shuffle, seed=seed, drop_remainder=False,
+    )
+    base.set_epoch(epoch)
+    remaining = base.shard_indices()  # the epoch's full global permutation
+    per_step = int(per_step)
+    for world, steps in lineage:
+        world, steps = int(world), int(steps)
+        if world <= 0 or steps < 0:
+            raise ValueError(f"bad lineage segment ({world}, {steps})")
+        stream = _pad_to_multiple(remaining, world)
+        shards = [stream[r::world] for r in range(world)]
+        consumed = steps * per_step
+        if consumed > len(shards[0]):
+            raise ValueError(
+                f"lineage segment ({world}, {steps}) consumes {consumed} "
+                f"of {len(shards[0])}-sample shards"
+            )
+        remaining = np.concatenate([s[consumed:] for s in shards])
+    # min shard length, so every survivor runs the identical step count.
+    steps_each = (len(remaining) // new_world) // per_step
+    mine = remaining[new_shard_id::new_world][: steps_each * per_step]
+    return np.ascontiguousarray(mine)
+
+
+def _pad_to_multiple(indices: np.ndarray, shards: int) -> np.ndarray:
+    """`ShardedSampler`'s pad-by-wraparound, applied to an explicit stream."""
+    total = -(-len(indices) // shards) * shards
+    pad = total - len(indices)
+    if not pad:
+        return indices
+    reps = -(-pad // max(1, len(indices)))
+    return np.concatenate([indices] + [indices] * reps)[:total]
+
+
+class ElasticTailSampler:
+    """Explicit per-shard index stream for a re-split epoch tail.
+
+    Drop-in for `ShardedSampler` inside `DataPipeline` (same
+    ``shard_indices``/``__len__``/``set_epoch`` surface) carrying the
+    output of :func:`elastic_resplit`. ``set_epoch`` is a guarded no-op:
+    the tail belongs to exactly one epoch, and silently reseeding it would
+    replay consumed samples.
+    """
+
+    def __init__(self, indices: np.ndarray, epoch: int):
+        self._indices = np.ascontiguousarray(np.asarray(indices, np.int64))
+        self.epoch = int(epoch)
+
+    def set_epoch(self, epoch: int) -> None:
+        if int(epoch) != self.epoch:
+            raise ValueError(
+                f"ElasticTailSampler is pinned to epoch {self.epoch}; "
+                f"set_epoch({epoch}) would replay consumed samples"
+            )
+
+    def __len__(self) -> int:
+        return len(self._indices)
+
+    def shard_indices(self) -> np.ndarray:
+        return self._indices

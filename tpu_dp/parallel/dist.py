@@ -19,6 +19,7 @@ process group, and barriers. Here the same contract is expressed TPU-first:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 from typing import Sequence
 
@@ -26,12 +27,51 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+logger = logging.getLogger(__name__)
+
 DATA_AXIS = "data"
 # Reserved second axis so the mesh API does not preclude tensor/model
 # parallelism later (SURVEY.md §2 "Parallelism strategies"); size 1 for DP.
 MODEL_AXIS = "model"
 
 _initialized_distributed = False
+
+# Coordination-service objects abandoned by an elastic regroup
+# (`abandon_distributed`). They are deliberately kept reachable — and made
+# immortal — for the life of the process; see `abandon_distributed`.
+_GRAVEYARD: list = []
+
+
+def _maybe_enable_cpu_collectives() -> None:
+    """Turn on gloo cross-process collectives for CPU-backend meshes.
+
+    The CPU PJRT client is built without a cross-process collectives
+    implementation by default, so a multi-process CPU run (the test/dev
+    topology) fails its first sharded computation with "Multiprocess
+    computations aren't implemented on the CPU backend". jaxlib ships a
+    gloo TCP implementation behind ``jax_cpu_collectives_implementation``;
+    select it whenever a multi-process bootstrap is requested on the CPU
+    platform and nothing was chosen explicitly. Must run before the first
+    backend is created (the choice is baked into the client); no-op
+    anywhere else.
+    """
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge.backends_are_initialized():
+            return
+        platforms = (
+            jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS") or ""
+        )
+        if "cpu" not in platforms.split(","):
+            return
+        # Flag-style option: readable only through its holder (plain
+        # `jax.config.<name>` attribute access raises for flags).
+        current = xla_bridge.CPU_COLLECTIVES_IMPLEMENTATION.value
+        if current in (None, "none"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # unknown jaxlib layout: leave the default alone
+        logger.debug("cpu collectives auto-config skipped", exc_info=True)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,6 +103,7 @@ def initialize(
     num_processes: int | None = None,
     process_id: int | None = None,
     initialization_timeout: int | None = None,
+    elastic: bool = False,
 ) -> DistContext:
     """Bootstrap multi-host JAX if requested; always return the topology.
 
@@ -86,7 +127,34 @@ def initialize(
     want_multiprocess = coordinator_address is not None and (
         num_processes is None or num_processes > 1
     )
+    if want_multiprocess and elastic:
+        # Elastic runs must come up on the regroup-tolerant bootstrap from
+        # step zero: the stock client/service pair enforces job-wide
+        # fate-sharing (missed heartbeats and propagated errors terminate
+        # every process — see the elastic section below), which would kill
+        # the survivors the protocol exists to save. Requires the explicit
+        # process ids (the env-var contract above already resolved them).
+        from jax._src import distributed
+
+        if distributed.global_state.client is not None:
+            return DistContext(
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+                local_device_count=jax.local_device_count(),
+                global_device_count=jax.device_count(),
+                coordinator_address=coordinator_address,
+            )
+        if num_processes is None or process_id is None:
+            raise ValueError(
+                "elastic multi-process bootstrap needs explicit "
+                "num_processes and process_id"
+            )
+        return elastic_initialize(
+            coordinator_address, num_processes, process_id,
+            initialization_timeout=initialization_timeout or 60,
+        )
     if want_multiprocess and not _initialized_distributed:
+        _maybe_enable_cpu_collectives()
         # Failure detection (SURVEY.md §5 — absent in the reference, whose
         # init_process_group has no timeout): a bounded rendezvous that
         # surfaces which coordinator was unreachable instead of hanging.
@@ -229,6 +297,218 @@ def fault_tolerant_barrier(mesh: Mesh | None = None, retries: int = 2,
             f"{jax.process_count()} after {retries + 1} attempts: {e}",
             rank=jax.process_index(), world=jax.process_count(),
         ) from e
+
+
+# ---------------------------------------------------------------------------
+# Elastic world size (tpu_dp.resilience.elastic, docs/RESILIENCE.md).
+#
+# A preempted rank must not end the run: the survivors tear down the
+# distributed context and re-`initialize` it at world N-1. Three properties
+# of the stock `jax.distributed` stack make that impossible as-is, each
+# worked around here:
+#
+# 1. `jax.distributed.initialize` refuses to run once backends exist, and
+#    `State.initialize` hardwires client options — so `elastic_initialize`
+#    builds the coordination service/client itself (same primitives) and
+#    installs them into `jax._src.distributed.global_state`, which is where
+#    backend creation reads the topology from.
+# 2. The coordination service's built-in health checking is a *job killer*:
+#    when a task dies, the service propagates a fatal error that every
+#    surviving client's poll thread turns into process termination — the
+#    exact opposite of elastic. Heartbeat checking is therefore configured
+#    effectively off (interval huge), and peer-death detection belongs to
+#    the framework's own layers (obs heartbeats, PeerFailedError, the
+#    membership ledger).
+# 3. `client.shutdown()` is a barrier over *all* tasks — with a dead peer it
+#    times out and the propagated barrier error kills the survivors; and a
+#    destroyed coordination *service* kills any process whose old client
+#    poll thread is still attached (the poll threads outlive the Python
+#    handle). `abandon_distributed` therefore never shuts the old context
+#    down: the old client/service objects are made immortal (a deliberate,
+#    bounded leak — one service socket + two threads per regroup) and the
+#    backends are cleared so the next `elastic_initialize` starts clean.
+# ---------------------------------------------------------------------------
+
+#: effectively-disabled coordination-service health checking (seconds /
+#: missed count): elastic runs do their own failure detection.
+_ELASTIC_HEARTBEAT_S = 600
+_ELASTIC_MAX_MISSING = 1_000_000
+
+
+def elastic_initialize(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    initialization_timeout: int = 60,
+) -> DistContext:
+    """Bootstrap (or re-bootstrap) a regroup-tolerant distributed context.
+
+    Usable both for the first membership epoch and after
+    `abandon_distributed` — unlike `jax.distributed.initialize`, which can
+    only ever run once per process. ``num_processes == 1`` degrades to
+    plain single-process mode (no coordination service at all).
+    """
+    from jax._src import distributed
+
+    st = distributed.global_state
+    if st.client is not None:
+        raise RuntimeError(
+            "elastic_initialize: a distributed context is already live; "
+            "call abandon_distributed() first"
+        )
+    global _initialized_distributed
+    _maybe_enable_cpu_collectives()
+    if num_processes == 1:
+        st.process_id, st.num_processes = 0, 1
+        st.coordinator_address = None
+        # Plain single-process from here on; `shutdown()` must not try to
+        # tear down a coordination service that no longer exists.
+        _initialized_distributed = False
+        return DistContext(
+            process_index=0, process_count=1,
+            local_device_count=jax.local_device_count(),
+            global_device_count=jax.device_count(),
+            coordinator_address=None,
+        )
+    from jax._src.lib import xla_extension as xe
+
+    if process_id == 0:
+        st.service = xe.get_distributed_runtime_service(
+            "[::]:" + coordinator_address.rsplit(":", 1)[1],
+            num_processes,
+            heartbeat_interval=_ELASTIC_HEARTBEAT_S,
+            max_missing_heartbeats=_ELASTIC_MAX_MISSING,
+            shutdown_timeout=5,
+        )
+    st.client = xe.get_distributed_runtime_client(
+        coordinator_address, process_id,
+        init_timeout=initialization_timeout, shutdown_timeout=5,
+        heartbeat_interval=_ELASTIC_HEARTBEAT_S,
+        max_missing_heartbeats=_ELASTIC_MAX_MISSING,
+        shutdown_on_destruction=False, use_compression=True,
+    )
+    try:
+        st.client.connect()
+    except Exception as e:
+        # A failed connect must leave the state re-initializable (the
+        # caller may retry on a fresh epoch record).
+        st.client = None
+        if process_id == 0:
+            st.service = None
+        raise RuntimeError(
+            f"elastic bootstrap failed (coordinator {coordinator_address}, "
+            f"process {process_id}/{num_processes}): {e}"
+        ) from e
+    st.process_id = process_id
+    st.num_processes = num_processes
+    st.coordinator_address = coordinator_address
+    # The elastic teardown path owns this context; the stock
+    # `jax.distributed.shutdown` (whose shutdown barrier would hang/abort
+    # on a dead peer) must never run against it.
+    _initialized_distributed = False
+    return DistContext(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+        coordinator_address=coordinator_address,
+    )
+
+
+def abandon_distributed() -> None:
+    """Walk away from the current distributed context without a barrier.
+
+    The regroup teardown: the old context may contain a dead peer, so the
+    cooperative `shutdown()` protocol is unusable (see the module notes
+    above). The old client/service objects are parked in a graveyard and
+    made immortal — their C++ destructors close sockets that still-running
+    poll threads (ours and surviving peers') are attached to, which the
+    coordination runtime escalates to process termination; never destroying
+    them is the only safe disposal. Backends and compile caches are then
+    cleared so the next `elastic_initialize` rebuilds the device view.
+    """
+    import ctypes
+
+    from jax._src import distributed
+
+    st = distributed.global_state
+    for obj in (st.client, st.service):
+        if obj is not None:
+            ctypes.pythonapi.Py_IncRef(ctypes.py_object(obj))
+            _GRAVEYARD.append(obj)
+    st.client = None
+    st.service = None
+    st.preemption_sync_manager = None
+    global _initialized_distributed
+    _initialized_distributed = False
+    import jax.extend.backend as _backend
+
+    jax.clear_caches()  # executables pinned to the abandoned device view
+    _backend.clear_backends()
+
+
+def park_distributed() -> None:
+    """Immortalize the live coordination objects; keep them serving.
+
+    The end-of-run counterpart of `abandon_distributed`: at interpreter
+    teardown the coordination client/service destructors close sockets
+    that peers' (and this process's own) poll threads are still attached
+    to, which the coordination runtime escalates to process termination —
+    turning a clean exit into SIGABRT depending on which survivor exits
+    first. Parking pins the objects for the remainder of the process
+    (everything keeps working; the OS reclaims at exit) so destructors
+    simply never run. Idempotent; no-op single-process.
+    """
+    import ctypes
+
+    from jax._src import distributed
+
+    st = distributed.global_state
+    for obj in (st.client, st.service):
+        if obj is not None and not any(g is obj for g in _GRAVEYARD):
+            ctypes.pythonapi.Py_IncRef(ctypes.py_object(obj))
+            _GRAVEYARD.append(obj)
+
+
+def agree_token(name: str, make, timeout_s: float = 60.0) -> str:
+    """One string every process of this launch agrees on (rank 0 mints it).
+
+    Rides the coordination service's key-value store — host-level RPCs,
+    usable before any device computation. The store is per-service-
+    instance, so the token is unique per *launch*: the elastic membership
+    ledger keys its generation directory off it, guaranteeing a restarted
+    incarnation never adopts a previous incarnation's ledger files even
+    when it resumes from the same step. Single-process: just ``make()``.
+    """
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        return str(make())
+    key = f"tpu_dp:token:{name}"
+    if jax.process_index() == 0:  # dplint: allow(DP101) host-level KV mint
+        token = str(make())
+        client.key_value_set(key, token)
+        return token
+    return client.blocking_key_value_get(key, int(timeout_s * 1000))
+
+
+def membership_barrier(tag: str, epoch: int, timeout_s: float = 60.0) -> None:
+    """Host-level barrier over the *current* membership epoch's processes.
+
+    Runs on the coordination service (no device collectives — usable
+    before the first compiled step of a fresh epoch), with the membership
+    epoch baked into the barrier id so a straggler from epoch N can never
+    satisfy — or poison — epoch N+1's rendezvous. Single-process: no-op.
+    """
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        return
+    client.wait_at_barrier(
+        f"tpu_dp:me{int(epoch)}:{tag}", timeout_in_ms=int(timeout_s * 1000)
+    )
 
 
 def verify_collective_fingerprint(digest: str, tag: str = "train_step") -> str:

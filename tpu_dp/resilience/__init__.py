@@ -2,7 +2,7 @@
 
 The subsystem the reference DDP tutorial entirely lacks (its training run
 dies permanently with any rank, SURVEY.md §5) and the roadmap's
-long-running multi-host scenarios require. Four pieces, composable and
+long-running multi-host scenarios require. Five pieces, composable and
 individually usable:
 
 - `snapshot` — async step-cadence snapshots of the live `TrainState`
@@ -11,13 +11,25 @@ individually usable:
   `resume_latest` to restore the newest complete state;
 - `retry` — bounded exponential-backoff retry + `PeerFailedError` with
   rank attribution, wrapping the native host-ring collectives;
-- `faultinject` — deterministic kill/preempt/delay/drop injection for the
-  resilience test suite (`tests/test_resilience.py`).
+- `faultinject` — deterministic kill/preempt/delay/drop/leave injection
+  for the resilience test suite (`tests/test_resilience.py`);
+- `elastic` — membership-epoch regroup: a preempted rank shrinks the mesh
+  to the survivors (shared-filesystem ledger rendezvous, re-`initialize`
+  at world N-1, checkpoint reshard, mid-epoch sampler re-split, DP304
+  fingerprint re-verification) instead of ending the run.
 
 See docs/RESILIENCE.md for the snapshot format and the preemption/resume
 contract.
 """
 
+from tpu_dp.resilience.elastic import (
+    MEMBERSHIP_SCHEMA,
+    ElasticCoordinator,
+    ElasticError,
+    MembershipLedger,
+    MembershipRecord,
+    QuiescePlan,
+)
 from tpu_dp.resilience.faultinject import (
     KILL_EXIT_CODE,
     FaultInjector,
@@ -27,6 +39,7 @@ from tpu_dp.resilience.preempt import (
     PREEMPTED_EXIT_CODE,
     PreemptedError,
     PreemptionHandler,
+    find_candidates,
     find_latest,
     resume_latest,
 )
@@ -39,16 +52,23 @@ from tpu_dp.resilience.retry import (
 from tpu_dp.resilience.snapshot import SnapshotManager
 
 __all__ = [
+    "ElasticCoordinator",
+    "ElasticError",
     "FaultInjector",
     "FaultPlan",
     "KILL_EXIT_CODE",
+    "MEMBERSHIP_SCHEMA",
+    "MembershipLedger",
+    "MembershipRecord",
     "PREEMPTED_EXIT_CODE",
     "PeerFailedError",
     "PreemptedError",
     "PreemptionHandler",
+    "QuiescePlan",
     "ResilientRing",
     "SnapshotManager",
     "backoff_delays",
+    "find_candidates",
     "find_latest",
     "resume_latest",
     "retry_call",

@@ -1,0 +1,664 @@
+"""Elastic world size: survive preemption by shrinking the mesh.
+
+The last robustness gap (ROADMAP item 3): the framework can snapshot on
+SIGTERM (PR 1), reshard optimizer state across world sizes (PR 4), and
+detect a missing rank via heartbeats (PR 5) — but a preempted rank still
+ends the run. This module closes the preempt→regroup loop: when a rank is
+evicted, the survivors rendezvous through a **shared-filesystem membership
+ledger**, agree on a resume step, tear down and re-`initialize` the
+distributed context at world N-1 (`tpu_dp.parallel.dist.elastic_initialize`
+/ `abandon_distributed`), reload via the existing `load_checkpoint`
+resharding path, re-split the sampler over the survivors
+(`tpu_dp.data.sampler.elastic_resplit` — every remaining sample of the
+interrupted epoch visited exactly once), and re-verify the DP304 collective
+fingerprint on the shrunk mesh before the first post-regroup step.
+
+Why a filesystem ledger and not collectives: regroup coordination must work
+exactly when collectives are the thing that is broken (a dead peer wedges
+every in-flight collective), and must span the gap between two distributed
+contexts when no client exists at all. The ledger needs only the shared
+filesystem the checkpoints already require (`docs/RESILIENCE.md`); every
+write is atomic (tmp + rename / exclusive link), every decision is either
+derived from an identical complete file set or published by a single
+writer, so ranks can never disagree.
+
+Membership ledger layout (``<membership_dir>/<generation>/``)::
+
+    epoch_0000.json      # membership record: epoch, members, coordinator,
+                         # departed, resume {steps_done, lineage, ...}
+    q_e0001_r00002.json  # quiesce check-in of stable rank 2 for the
+                         # transition to epoch 1: step reached, leaving?
+    plan_e0001.json      # the agreed transition plan (single writer,
+                         # exclusive-create: flavor, stop_step, survivors)
+    q_e0001_r00002.done  # post-quiesce ack (final snapshot committed)
+    left_r00002.json     # graceful-departure confirmation
+    suspect_r00002.json  # a peer flagged dead (stale heartbeat) by rank 0
+
+A **generation** is one process incarnation of the job (a full restart via
+``--resume=auto`` starts a new generation); membership epochs count
+regroups within a generation. A rank's **stable id (sid)** is its process
+index at generation start — dense ranks are reassigned every epoch, sids
+never.
+
+Two regroup flavors, decided by the plan writer from the check-in set:
+
+- **graceful** — every member checked in (the departing rank announced
+  itself: SIGTERM, ``TPU_DP_FAULT=preempt:``/``leave:``). All members keep
+  stepping to the agreed ``stop_step`` (the max of the check-in steps, in
+  the common window-boundary sequence), rank 0 commits a final snapshot at
+  exactly that step, leavers exit 143, survivors regroup. Nothing is
+  replayed and nothing dropped: steps ≤ stop_step ran at world N, steps
+  after it run at world N-1.
+- **rollback** — a member vanished without a word (check-in timeout, a
+  `PeerFailedError`, a stale heartbeat). The survivors cannot step (their
+  collectives are wedged), so they resume from the newest *complete*
+  snapshot; the steps since it are re-run on the shrunk mesh.
+
+The failure matrix (who detects, who decides) is documented in
+docs/RESILIENCE.md "Elastic world size".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import socket
+import time
+from pathlib import Path
+from typing import Any, Callable, Sequence
+
+from tpu_dp.obs.counters import counters as _counters
+
+logger = logging.getLogger(__name__)
+
+#: membership record / ledger file schema version.
+MEMBERSHIP_SCHEMA = 1
+
+
+class ElasticError(RuntimeError):
+    """A regroup could not complete (quorum lost, timeout, bad ledger)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipRecord:
+    """One membership epoch: who is in the job and where it resumes."""
+
+    epoch: int
+    members: tuple[int, ...]          # stable ids, sorted
+    coordinator: str | None           # host:port; None for world 1
+    departed: tuple[dict, ...] = ()   # [{"sid": s, "reason": r}, ...]
+    resume: dict | None = None        # {"epoch", "steps_done", "lineage",
+                                      #  "global_step", "snapshot_dir"}
+    reason: str = "initial"
+    ts: float = 0.0
+
+    @property
+    def world(self) -> int:
+        return len(self.members)
+
+    def rank_of(self, sid: int) -> int:
+        """Dense rank of ``sid`` in this epoch (sorted-sid order)."""
+        try:
+            return self.members.index(sid)
+        except ValueError:
+            raise ElasticError(
+                f"stable rank {sid} is not a member of epoch {self.epoch} "
+                f"(members: {list(self.members)})"
+            ) from None
+
+    def to_json(self) -> dict:
+        return {
+            "schema": MEMBERSHIP_SCHEMA,
+            "epoch": self.epoch,
+            "members": list(self.members),
+            "world": self.world,
+            "coordinator": self.coordinator,
+            "departed": list(self.departed),
+            "resume": self.resume,
+            "reason": self.reason,
+            "ts": self.ts,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "MembershipRecord":
+        if d.get("schema") != MEMBERSHIP_SCHEMA:
+            raise ElasticError(
+                f"membership record schema {d.get('schema')!r} != "
+                f"{MEMBERSHIP_SCHEMA}"
+            )
+        return cls(
+            epoch=int(d["epoch"]),
+            members=tuple(int(m) for m in d["members"]),
+            coordinator=d.get("coordinator"),
+            departed=tuple(d.get("departed") or ()),
+            resume=d.get("resume"),
+            reason=str(d.get("reason", "")),
+            ts=float(d.get("ts", 0.0)),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class QuiescePlan:
+    """The agreed transition out of the current membership epoch.
+
+    ``stop_step`` is a *threshold* on the global optimizer step, not a
+    position: every member keeps stepping and quiesces at its first window
+    boundary with ``host_step >= stop_step``. Because all members dispatch
+    the identical boundary sequence, that first boundary is the same
+    global position on every rank — without anyone having to enumerate the
+    other ranks' window structure. The publisher chooses
+    ``max(check-in steps) + 2×max(window) + 1``, which no member can have
+    passed before its next plan poll (check-ins refresh every boundary, so
+    a member is at most one window past its last published step, and reads
+    the plan at most one window later). Rollback plans ignore it — a
+    wedged mesh cannot step; state reloads from disk.
+    """
+
+    epoch: int                    # the NEW epoch being formed
+    flavor: str                   # "graceful" | "rollback"
+    stop_step: int                # global-step threshold (see above)
+    train_epoch: int              # dataset epoch being interrupted
+    leavers: tuple[int, ...]      # sids departing gracefully
+    departed: tuple[dict, ...]    # sids that vanished ({"sid","reason"})
+    survivors: tuple[int, ...]    # sids forming the new epoch
+
+    def to_json(self) -> dict:
+        return {
+            "schema": MEMBERSHIP_SCHEMA,
+            "epoch": self.epoch,
+            "flavor": self.flavor,
+            "stop_step": self.stop_step,
+            "train_epoch": self.train_epoch,
+            "leavers": list(self.leavers),
+            "departed": list(self.departed),
+            "survivors": list(self.survivors),
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "QuiescePlan":
+        return cls(
+            epoch=int(d["epoch"]), flavor=str(d["flavor"]),
+            stop_step=int(d["stop_step"]),
+            train_epoch=int(d.get("train_epoch", 0)),
+            leavers=tuple(int(x) for x in d["leavers"]),
+            departed=tuple(d["departed"]),
+            survivors=tuple(int(x) for x in d["survivors"]),
+        )
+
+
+def _atomic_write_json(path: Path, payload: dict) -> None:
+    tmp = path.with_name(path.name + f".tmp{os.getpid()}")
+    tmp.write_text(json.dumps(payload, indent=2, default=str))
+    os.replace(tmp, path)
+
+
+def _exclusive_write_json(path: Path, payload: dict) -> bool:
+    """First-writer-wins publish; True when THIS call created the file.
+
+    `os.link` of a private tmp onto the target is atomic-create on POSIX:
+    a losing writer gets EEXIST and adopts the canonical file instead.
+    """
+    tmp = path.with_name(path.name + f".tmp{os.getpid()}")
+    tmp.write_text(json.dumps(payload, indent=2, default=str))
+    try:
+        os.link(tmp, path)
+        return True
+    except FileExistsError:
+        return False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _read_json(path: Path) -> dict | None:
+    """Parse ``path``; None when absent or torn (caller re-polls)."""
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port on ``host`` (regroup coordinator)."""
+    with socket.socket() as s:
+        s.bind((host if host else "", 0))
+        return int(s.getsockname()[1])
+
+
+class MembershipLedger:
+    """The shared-filesystem half of the protocol — no jax, no devices.
+
+    Every method is either an atomic publish or a bounded poll; the
+    trainer-facing `ElasticCoordinator` composes them. Kept free of any
+    distributed runtime so the full protocol is unit-testable with plain
+    threads against one tmp dir (`tests/test_elastic.py`).
+    """
+
+    def __init__(self, gen_dir: str | os.PathLike, sid: int):
+        self.dir = Path(gen_dir)
+        self.sid = int(sid)
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    # -- membership records --------------------------------------------
+
+    def _epoch_path(self, epoch: int) -> Path:
+        return self.dir / f"epoch_{int(epoch):04d}.json"
+
+    def write_initial(self, members: Sequence[int],
+                      coordinator: str | None) -> MembershipRecord:
+        """Publish epoch 0 (generation leader only; idempotent)."""
+        rec = MembershipRecord(
+            epoch=0, members=tuple(sorted(int(m) for m in members)),
+            coordinator=coordinator, reason="initial", ts=time.time(),
+        )
+        _exclusive_write_json(self._epoch_path(0), rec.to_json())
+        return self.current()  # canonical copy (a racing writer may have won)
+
+    def current(self) -> MembershipRecord:
+        """The newest complete membership record."""
+        recs = sorted(self.dir.glob("epoch_*.json"))
+        for path in reversed(recs):
+            d = _read_json(path)
+            if d is not None:
+                return MembershipRecord.from_json(d)
+        raise ElasticError(f"no membership record under {self.dir}")
+
+    def await_epoch(self, epoch: int, timeout_s: float,
+                    poll_s: float = 0.05) -> MembershipRecord:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            d = _read_json(self._epoch_path(epoch))
+            if d is not None:
+                return MembershipRecord.from_json(d)
+            if time.monotonic() > deadline:
+                raise ElasticError(
+                    f"membership epoch {epoch} record did not appear within "
+                    f"{timeout_s:.0f}s (sid {self.sid}); the epoch leader "
+                    f"may have died mid-regroup"
+                )
+            time.sleep(poll_s)
+
+    def publish_epoch(self, rec: MembershipRecord) -> MembershipRecord:
+        """Single-writer epoch publish (exclusive; losers adopt the winner)."""
+        _exclusive_write_json(self._epoch_path(rec.epoch), rec.to_json())
+        return MembershipRecord.from_json(_read_json(self._epoch_path(rec.epoch)))
+
+    # -- suspicion / departure -----------------------------------------
+
+    def mark_suspect(self, epoch: int, sid: int, reason: str) -> None:
+        """Publish "sid looks dead" (stale heartbeat, exhausted retries).
+
+        Any member may write it; observers fold it into their next poll.
+        Scoped to the ``epoch`` transition it accuses: a suspect that in
+        fact survives the regroup (a false alarm — slow, not dead) must
+        not keep re-triggering regroups of every later epoch, so once the
+        transition completes its suspect files are inert.
+        """
+        path = self.dir / f"suspect_e{int(epoch):04d}_r{int(sid):05d}.json"
+        if not path.exists():
+            _atomic_write_json(path, {
+                "sid": int(sid), "reason": reason,
+                "by": self.sid, "ts": time.time(),
+            })
+
+    def suspects(self, epoch: int) -> dict[int, str]:
+        """Suspects accused for the ``epoch`` transition."""
+        out: dict[int, str] = {}
+        for path in self.dir.glob(f"suspect_e{int(epoch):04d}_r*.json"):
+            d = _read_json(path)
+            if d is not None:
+                out[int(d["sid"])] = str(d.get("reason", ""))
+        return out
+
+    def confirm_left(self, step: int) -> None:
+        _atomic_write_json(self.dir / f"left_r{self.sid:05d}.json", {
+            "sid": self.sid, "step": int(step), "ts": time.time(),
+        })
+
+    # -- quiesce --------------------------------------------------------
+
+    def _q_path(self, epoch: int, sid: int) -> Path:
+        return self.dir / f"q_e{int(epoch):04d}_r{int(sid):05d}.json"
+
+    def check_in(self, epoch: int, step: int, leaving: bool,
+                 flavor: str, window: int = 1) -> None:
+        """Publish/refresh this rank's quiesce check-in (every boundary).
+
+        Refreshed, not write-once: a quiescing rank KEEPS STEPPING while
+        the plan converges (stopping would wedge every peer's in-flight
+        collective), so its published position must track its boundary.
+        ``window`` (its dispatch window size) feeds the publisher's
+        stop-threshold margin.
+        """
+        _atomic_write_json(self._q_path(epoch, self.sid), {
+            "sid": self.sid, "step": int(step), "leaving": bool(leaving),
+            "flavor": flavor, "window": max(1, int(window)),
+            "ts": time.time(),
+        })
+
+    def check_ins(self, epoch: int) -> dict[int, dict]:
+        out: dict[int, dict] = {}
+        for path in self.dir.glob(f"q_e{int(epoch):04d}_r*.json"):
+            d = _read_json(path)
+            if d is not None:
+                out[int(d["sid"])] = d
+        return out
+
+    def quiesce_triggered(self, epoch: int) -> bool:
+        """True once ANY member checked in for the ``epoch`` transition."""
+        return any(self.dir.glob(f"q_e{int(epoch):04d}_r*.json"))
+
+    def try_plan(self, epoch: int) -> QuiescePlan | None:
+        """The published transition plan, if any (non-blocking)."""
+        d = _read_json(self.dir / f"plan_e{int(epoch):04d}.json")
+        return QuiescePlan.from_json(d) if d is not None else None
+
+    def maybe_publish_plan(self, epoch: int, members: Sequence[int],
+                           train_epoch: int, timed_out: bool) -> None:
+        """Publish THE plan when this rank is the acting leader and the
+        collection is ready (single exclusive writer).
+
+        Ready: every current member checked in (graceful), or the caller's
+        collection window timed out (missing members are declared departed
+        → rollback). Acting leader: the lowest sid *among the check-ins* —
+        the natural leader might be the dead rank. Exclusive create means
+        a slow second publisher loses and adopts the canonical file, so
+        divergent local views (a check-in landing just after one rank's
+        timeout) cannot fork the membership.
+        """
+        members = sorted(int(m) for m in members)
+        seen = self.check_ins(epoch)
+        if not seen or min(seen) != self.sid:
+            return
+        complete = all(m in seen for m in members)
+        if not (complete or timed_out):
+            return
+        suspects = self.suspects(epoch)
+        departed = [
+            {"sid": m,
+             "reason": suspects.get(m, "no quiesce check-in (timeout)")}
+            for m in members if m not in seen
+        ]
+        leavers = tuple(s for s, d in sorted(seen.items()) if d["leaving"])
+        rollback = bool(departed) or any(
+            d["flavor"] == "rollback" for d in seen.values()
+        )
+        max_step = max(d["step"] for d in seen.values())
+        max_window = max(int(d.get("window", 1)) for d in seen.values())
+        plan = QuiescePlan(
+            epoch=epoch,
+            flavor="rollback" if rollback else "graceful",
+            # Graceful: the stop THRESHOLD (see QuiescePlan) — far enough
+            # that no still-stepping member can overshoot it before its
+            # next plan poll; a lone member has nobody to overshoot, so it
+            # stops where it is. Rollback: informational only.
+            stop_step=(max_step + 2 * max_window + 1)
+            if not rollback and len(members) > 1 else max_step,
+            train_epoch=train_epoch,
+            leavers=leavers,
+            departed=tuple(departed),
+            survivors=tuple(s for s in sorted(seen) if s not in leavers),
+        )
+        _exclusive_write_json(
+            self.dir / f"plan_e{int(epoch):04d}.json", plan.to_json()
+        )
+
+    # -- post-quiesce barrier ------------------------------------------
+
+    def ack_quiesced(self, epoch: int) -> None:
+        (self.dir / f"q_e{int(epoch):04d}_r{self.sid:05d}.done").touch()
+
+    def await_quiesced(self, epoch: int, sids: Sequence[int],
+                       timeout_s: float, poll_s: float = 0.05) -> list[int]:
+        """Wait for everyone's post-quiesce ack; returns the sids that
+        never acked (logged by the caller — by this point the final
+        snapshot is committed, so a straggler must not wedge the regroup).
+        """
+        deadline = time.monotonic() + timeout_s
+        pending = {int(s) for s in sids}
+        while pending and time.monotonic() <= deadline:
+            pending = {
+                s for s in pending
+                if not (self.dir / f"q_e{int(epoch):04d}_r{s:05d}.done").exists()
+            }
+            if pending:
+                time.sleep(poll_s)
+        return sorted(pending)
+
+
+class ElasticCoordinator:
+    """Trainer-facing glue: ledger protocol + distributed-context surgery.
+
+    One instance per process per generation. The trainer consults
+    :meth:`poll` once per window boundary (cheap: one directory glob at
+    the configured cadence), runs :meth:`quiesce` when a trigger fires,
+    and — on the survivor side — :meth:`establish` + :meth:`reinitialize`
+    to form the next membership epoch.
+    """
+
+    def __init__(
+        self,
+        membership_dir: str | os.PathLike,
+        generation: str,
+        sid: int,
+        world: int,
+        coordinator_address: str | None,
+        regroup_timeout_s: float = 60.0,
+        poll_every_steps: int = 1,
+        coordinator_host: str = "",
+        min_world: int = 1,
+    ):
+        self.root = Path(membership_dir)
+        self.ledger = MembershipLedger(self.root / generation, sid)
+        self.sid = int(sid)
+        self.regroup_timeout_s = float(regroup_timeout_s)
+        self.poll_every_steps = max(1, int(poll_every_steps))
+        self.coordinator_host = coordinator_host
+        self.min_world = max(1, int(min_world))
+        self._initial_coordinator = coordinator_address
+        self._poll_marker = -1
+        self._q_started: float | None = None  # monotonic quiesce start
+        if self.sid == 0:
+            self.ledger.write_initial(range(world), coordinator_address)
+        # Non-leaders may race ahead of the leader's first write; tolerate
+        # a short wait for the generation's epoch-0 record.
+        self.record = self.ledger.await_epoch(0, timeout_s=regroup_timeout_s)
+
+    # -- detection ------------------------------------------------------
+
+    def poll(self, host_step: int, leave_requested: bool = False) -> str | None:
+        """Regroup trigger at a window boundary, or None.
+
+        Returns "leave" (this rank was told to go — SIGTERM / injected),
+        "peer" (another member already checked in for the next epoch), or
+        "suspect" (a member was flagged dead). Ledger globbing is rate-
+        limited to every ``poll_every_steps`` boundary crossings; a local
+        leave request is never rate-limited.
+        """
+        if leave_requested:
+            return "leave"
+        step = int(host_step)
+        if self._poll_marker >= 0 and (
+            step // self.poll_every_steps
+            <= self._poll_marker // self.poll_every_steps
+        ):
+            return None
+        self._poll_marker = step
+        nxt = self.record.epoch + 1
+        if self.ledger.quiesce_triggered(nxt):
+            return "peer"
+        if any(s in self.record.members
+               for s in self.ledger.suspects(nxt)):
+            return "suspect"
+        return None
+
+    def mark_suspect(self, rank: int, reason: str) -> None:
+        """Flag a (dense) rank of the current epoch as dead (accusation
+        scoped to the next transition — see `MembershipLedger.mark_suspect`)."""
+        self.ledger.mark_suspect(
+            self.record.epoch + 1, self.record.members[rank], reason
+        )
+
+    # -- quiesce --------------------------------------------------------
+
+    @property
+    def quiescing(self) -> bool:
+        """A transition is in flight (checked in, plan not yet adopted)."""
+        return self._q_started is not None
+
+    def quiesce_step(self, train_epoch: int, host_step: int, leaving: bool,
+                     flavor: str = "graceful",
+                     window: int = 1) -> QuiescePlan | None:
+        """One non-blocking quiesce turn: refresh check-in, try to agree.
+
+        Called at every window boundary while the transition converges —
+        the caller KEEPS STEPPING in between (a stalled member would wedge
+        every peer's in-flight collective; the stop threshold in the
+        eventual plan is what actually halts the epoch). Returns the plan
+        once published, None while converging; raises `ElasticError` when
+        no plan appears within twice the regroup timeout (the acting
+        leader died mid-transition).
+        """
+        nxt = self.record.epoch + 1
+        now = time.monotonic()
+        if self._q_started is None:
+            self._q_started = now
+        self.ledger.check_in(nxt, host_step, leaving, flavor, window=window)
+        plan = self.ledger.try_plan(nxt)
+        if plan is None:
+            self.ledger.maybe_publish_plan(
+                nxt, self.record.members, train_epoch,
+                timed_out=now > self._q_started + self.regroup_timeout_s,
+            )
+            plan = self.ledger.try_plan(nxt)
+        if plan is not None:
+            self._q_started = None
+            logger.warning(
+                "elastic quiesce e%d (%s): stop threshold %d, leavers=%s "
+                "departed=%s survivors=%s (sid %d)",
+                plan.epoch, plan.flavor, plan.stop_step, list(plan.leavers),
+                [d["sid"] for d in plan.departed], list(plan.survivors),
+                self.sid,
+            )
+            return plan
+        if now > self._q_started + 2 * self.regroup_timeout_s:
+            raise ElasticError(
+                f"quiesce e{nxt}: no plan published within "
+                f"{2 * self.regroup_timeout_s:.0f}s (sid {self.sid}; the "
+                f"acting leader may have died mid-transition)"
+            )
+        return None
+
+    def quiesce_blocking(self, train_epoch: int, host_step: int,
+                         leaving: bool, flavor: str,
+                         window: int = 1, poll_s: float = 0.05) -> QuiescePlan:
+        """Converge without stepping — the rollback path (wedged mesh)."""
+        while True:
+            plan = self.quiesce_step(
+                train_epoch, host_step, leaving, flavor, window=window
+            )
+            if plan is not None:
+                return plan
+            time.sleep(poll_s)
+
+    def ack_and_await_quiesced(self, plan: QuiescePlan) -> None:
+        """Post-snapshot barrier over everyone still alive in the plan."""
+        self.ledger.ack_quiesced(plan.epoch)
+        missing = self.ledger.await_quiesced(
+            plan.epoch, plan.leavers + plan.survivors,
+            timeout_s=self.regroup_timeout_s,
+        )
+        if missing:
+            logger.warning(
+                "elastic quiesce e%d: no ack from sids %s within %.0fs — "
+                "proceeding (final snapshot already committed)",
+                plan.epoch, missing, self.regroup_timeout_s,
+            )
+
+    def confirm_left(self, step: int) -> None:
+        self.ledger.confirm_left(step)
+
+    # -- epoch formation (survivor side) --------------------------------
+
+    def establish(self, plan: QuiescePlan, resume: dict) -> MembershipRecord:
+        """Form the new epoch: the new leader publishes, everyone adopts.
+
+        ``resume`` (the new leader's view wins): epoch/steps_done/lineage/
+        global_step/snapshot_dir — everything a survivor needs to reload
+        and re-split. The new coordinator lands on the leader's host at a
+        freshly-probed port (world 1 needs none).
+        """
+        if len(plan.survivors) < self.min_world:
+            raise ElasticError(
+                f"regroup e{plan.epoch}: {len(plan.survivors)} survivor(s) "
+                f"< resilience.elastic_min_world={self.min_world}"
+            )
+        if self.sid not in plan.survivors:
+            raise ElasticError(
+                f"establish() called on non-survivor sid {self.sid}"
+            )
+        leader = min(plan.survivors)
+        if self.sid == leader:
+            coordinator = None
+            if len(plan.survivors) > 1:
+                host = self.coordinator_host or self._default_host()
+                # Known race: the probed port is released here and bound
+                # by the coordination service only in reinitialize(); an
+                # unrelated process can steal it in between, failing the
+                # regroup (the supervisor's restart then recovers). A
+                # held-socket handoff isn't possible through the runtime's
+                # service constructor, which takes an address string.
+                coordinator = f"{host}:{free_port(host)}"
+            rec = MembershipRecord(
+                epoch=plan.epoch, members=plan.survivors,
+                coordinator=coordinator,
+                departed=tuple(
+                    list(plan.departed)
+                    + [{"sid": s, "reason": "preempted (graceful)"}
+                       for s in plan.leavers]
+                ),
+                resume=resume, reason=plan.flavor, ts=time.time(),
+            )
+            self.record = self.ledger.publish_epoch(rec)
+        else:
+            self.record = self.ledger.await_epoch(
+                plan.epoch, timeout_s=self.regroup_timeout_s
+            )
+        return self.record
+
+    def _default_host(self) -> str:
+        old = self._initial_coordinator or ""
+        host = old.rsplit(":", 1)[0] if ":" in old else ""
+        if host in ("127.0.0.1", "localhost", "::1"):
+            return host  # single-host dev/test topology: stay on loopback
+        try:
+            return socket.gethostname()
+        except OSError:
+            return host or "127.0.0.1"
+
+    def reinitialize(self, record: MembershipRecord | None = None):
+        """Tear down the old context and bootstrap the new epoch's.
+
+        Returns the fresh `DistContext`. Publishes the regroup into the
+        obs counter registry (``elastic.membership_epoch`` gauge; the
+        trainer adds timings).
+        """
+        from tpu_dp.parallel import dist
+
+        rec = record or self.record
+        rank = rec.rank_of(self.sid)
+        # A rollback regroup rewinds the global step below the last poll
+        # marker; without a reset, ledger polling (peer/suspect detection)
+        # would stay suppressed for the whole replay window.
+        self._poll_marker = -1
+        dist.abandon_distributed()
+        ctx = dist.elastic_initialize(
+            rec.coordinator or "", rec.world, rank,
+            initialization_timeout=int(self.regroup_timeout_s),
+        )
+        _counters.gauge("elastic.membership_epoch", rec.epoch)
+        return ctx

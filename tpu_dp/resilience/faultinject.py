@@ -14,6 +14,13 @@ the latter so spawned worker processes inherit the plan)::
     kill:step=13             # os._exit(137) at the first step boundary >= 13
     kill:step=13,rank=1      # only on process 1 (default: every rank)
     preempt:step=9           # deliver SIGTERM to self (exercises the hook)
+    preempt:rank=2,step=9    # SIGTERM only on process 2 — the elastic
+                             # single-rank eviction (survivors regroup)
+    leave:step=9,rank=2      # signal-free preempt twin: sets the injector's
+                             # `leave_requested` flag the elastic trainer
+                             # polls — same regroup path, usable where a
+                             # real SIGTERM can't be (in-process pytest,
+                             # non-main threads)
     delay:step=5,ms=250      # sleep 250ms once (straggler simulation)
     drop:step=7              # arm a one-shot collective drop (ring retry path)
 
@@ -32,7 +39,7 @@ import time
 
 logger = logging.getLogger(__name__)
 
-_KINDS = ("kill", "preempt", "delay", "drop")
+_KINDS = ("kill", "preempt", "delay", "drop", "leave")
 #: exit code for an injected hard kill — SIGKILL's 128+9, the signature of
 #: a host OOM-killer / preemption-without-grace death.
 KILL_EXIT_CODE = 137
@@ -81,6 +88,9 @@ class FaultInjector:
         self.rank = int(rank)
         self.fired = False
         self._drop_armed = False
+        #: set by a fired ``leave`` plan; the elastic trainer polls it as a
+        #: local departure request (`tpu_dp.resilience.elastic`).
+        self.leave_requested = False
 
     @classmethod
     def from_spec(cls, spec: str, rank: int = 0) -> "FaultInjector | None":
@@ -129,6 +139,12 @@ class FaultInjector:
             time.sleep(plan.delay_ms / 1000.0)
         elif plan.kind == "drop":
             self._drop_armed = True
+        elif plan.kind == "leave":
+            logger.warning(
+                "fault injection: elastic leave request on rank %d at "
+                "step %d", self.rank, global_step,
+            )
+            self.leave_requested = True
 
     def take_drop(self) -> bool:
         """Consume the one-shot armed collective drop (ResilientRing hook)."""

@@ -112,31 +112,40 @@ def _manager_step(step_dir: Path) -> int:
     return int(step_dir.name.split("_")[1])
 
 
+def find_candidates(ckpt_dir: str | Path,
+                    snapshot_dir: str | Path | None = None
+                    ) -> list[tuple[Path, int]]:
+    """Every complete resumable save, best first.
+
+    ``(dir, global_step)`` pairs ordered newest-step-first (epoch
+    checkpoints win ties: same step ⇒ same state, and the epoch layout
+    resumes at a clean epoch start). Partially-written step dirs — the
+    signature of a crash mid-snapshot during preemption — are already
+    excluded (`CheckpointManager.complete_dirs`); callers that find the
+    best candidate unreadable fall back down this list instead of failing
+    the regroup (`resume_latest`). The flat pre-manager layout
+    (``<ckpt_dir>/state.msgpack``) is the last resort — it predates step
+    numbering.
+    """
+    ranked: list[tuple[int, int, Path]] = []  # (step, priority, dir)
+    for priority, root in ((1, ckpt_dir), (0, snapshot_dir)):
+        if root is None:
+            continue
+        for d in ckpt_lib.CheckpointManager(root).complete_dirs():
+            ranked.append((_manager_step(d), priority, d))
+    out = [(d, step) for step, _, d in
+           sorted(ranked, key=lambda c: (c[0], c[1]), reverse=True)]
+    if not out and ckpt_lib.checkpoint_exists(ckpt_dir):
+        out.append((Path(ckpt_dir), -1))
+    return out
+
+
 def find_latest(ckpt_dir: str | Path,
                 snapshot_dir: str | Path | None = None
                 ) -> tuple[Path, int] | None:
-    """Newest complete state across checkpoints and snapshots.
-
-    Returns ``(dir, global_step)`` of the highest-step complete save, or
-    None when there is nothing to resume from. Epoch checkpoints win ties
-    (same step ⇒ same state; the epoch layout resumes at a clean epoch
-    start). The flat pre-manager layout (``<ckpt_dir>/state.msgpack``) is
-    the fallback of last resort — it predates step numbering.
-    """
-    candidates: list[tuple[int, int, Path]] = []  # (step, priority, dir)
-    ckpt_latest = ckpt_lib.CheckpointManager(ckpt_dir).latest_dir()
-    if ckpt_latest is not None:
-        candidates.append((_manager_step(ckpt_latest), 1, ckpt_latest))
-    if snapshot_dir is not None:
-        snap_latest = ckpt_lib.CheckpointManager(snapshot_dir).latest_dir()
-        if snap_latest is not None:
-            candidates.append((_manager_step(snap_latest), 0, snap_latest))
-    if candidates:
-        step, _, best = max(candidates, key=lambda c: (c[0], c[1]))
-        return best, step
-    if ckpt_lib.checkpoint_exists(ckpt_dir):
-        return Path(ckpt_dir), -1
-    return None
+    """Newest complete state across checkpoints and snapshots (or None)."""
+    found = find_candidates(ckpt_dir, snapshot_dir)
+    return found[0] if found else None
 
 
 def resume_latest(target, ckpt_dir: str | Path,
@@ -147,13 +156,30 @@ def resume_latest(target, ckpt_dir: str | Path,
     caller fast-forwards the sampler by ``meta["steps_done"]``; an epoch
     checkpoint resumes at epoch ``meta["epoch"] + 1``, step 0.
     Raises FileNotFoundError when there is nothing to resume from.
+
+    Robust to a save corrupted by a dying host (truncated msgpack behind
+    an already-renamed file, unreadable meta): the bad candidate is
+    skipped with a warning and the previous complete one restores instead
+    — an elastic regroup must not fail because the final snapshot of a
+    preempted rank was torn.
     """
-    found = find_latest(ckpt_dir, snapshot_dir)
-    if found is None:
+    found = find_candidates(ckpt_dir, snapshot_dir)
+    if not found:
         raise FileNotFoundError(
             f"nothing to resume from under {ckpt_dir}"
             + (f" or {snapshot_dir}" if snapshot_dir else "")
         )
-    source, _ = found
-    state, meta = ckpt_lib.load_checkpoint(source, target)
-    return state, meta, source
+    last_err: Exception | None = None
+    for source, _ in found:
+        try:
+            state, meta = ckpt_lib.load_checkpoint(source, target)
+            return state, meta, source
+        except Exception as e:  # torn payload / unreadable meta
+            last_err = e
+            logger.warning(
+                "resume candidate %s is unreadable (%s); falling back to "
+                "the previous complete save", source, e,
+            )
+    raise RuntimeError(
+        f"every resume candidate under {ckpt_dir} is unreadable"
+    ) from last_err

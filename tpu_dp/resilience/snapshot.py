@@ -105,10 +105,17 @@ class SnapshotManager:
 
         The host copy happens NOW (synchronous, overlapping any in-flight
         disk write of the other buffer); serialization + IO are async.
-        Process-0-only like the underlying manager.
+        Process-0-only like the underlying manager — but when the state
+        holds cross-process-sharded leaves (multi-host sharded update),
+        host assembly is a collective every process must join before the
+        rank gate, or process 0 deadlocks mid-snapshot.
         """
         self._last_step = int(global_step)
         if jax.process_index() != 0:  # dplint: allow(DP101) host-only IO
+            from tpu_dp.checkpoint import _to_host, has_cross_process_leaves
+
+            if has_cross_process_leaves(state):
+                _to_host(state)  # participate in the cross-host assembly
             return None
         # Telemetry (tpu_dp.obs): `snapshot.write_s` is the step-blocking
         # cost (device→host copy + async-save handoff, which joins any

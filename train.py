@@ -59,6 +59,20 @@ def main(argv=None) -> int:
         # Telemetry rollup (train.obs=basic|full): span percentiles +
         # counters in the same summary line the run already emits.
         summary["obs"] = obs
+    if trainer.elastic is not None:
+        # Elastic rollup: a shrink must be visible in the one-line summary,
+        # not only in the membership ledger (docs/RESILIENCE.md).
+        from tpu_dp.obs.counters import counters as obs_counters
+
+        rec = trainer.elastic.record
+        summary["elastic"] = {
+            "membership_epoch": rec.epoch,
+            "world": rec.world,
+            "members": list(rec.members),
+            "regroups": int(obs_counters.get("elastic.regroups")),
+            "lost_ranks": int(obs_counters.get("elastic.lost_ranks")),
+            "regroup_s": round(obs_counters.get("elastic.regroup_s"), 3),
+        }
     print0(json.dumps(summary))
     return 0
 
