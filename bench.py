@@ -297,19 +297,21 @@ def compile_with_flops(jitted, *eg_args):
     return compiled, flops, stats
 
 
-def _make_step(model, opt, mesh, sched, use_pallas, update_sharding):
+def _make_step(model, opt, mesh, sched, use_pallas, update_sharding,
+               sentinel=False):
     """The production per-step program for the requested update mode:
     GSPMD (`make_train_step`) for replicated, explicit-collectives
-    `make_train_step_shard_map` for the sharded weight update."""
+    `make_train_step_shard_map` for the sharded weight update.
+    ``sentinel=True`` builds the guardrail variant (`--guard-overhead`)."""
     from tpu_dp.train import make_train_step, make_train_step_shard_map
 
     if update_sharding == "sharded":
         return make_train_step_shard_map(
             model, opt, mesh, sched, use_pallas_xent=use_pallas,
-            update_sharding=update_sharding,
+            update_sharding=update_sharding, sentinel=sentinel,
         )
     return make_train_step(model, opt, mesh, sched,
-                           use_pallas_xent=use_pallas)
+                           use_pallas_xent=use_pallas, sentinel=sentinel)
 
 
 def measure_point(cfg: dict) -> dict:
@@ -508,6 +510,49 @@ def measure_point(cfg: dict) -> dict:
             "overhead_pct": round((snap_s / plain_s - 1.0) * 100, 2),
         }
 
+    guard_rec = None
+    guard_steps = int(cfg.get("guard_overhead_steps", 0))
+    if guard_steps > 0 and window == 1:
+        # Guardrail-sentinel overhead (docs/RESILIENCE.md "Guardrails"):
+        # time the identical per-step loop twice — the plain program, then
+        # the sentinel program (on-device health summary + guarded update
+        # + guard_in input) INCLUDING the guard's per-window host fetch of
+        # the three health scalars, which is its real steady-state cost.
+        # Measured, not assumed: this block is what the "cheap on-device
+        # summary" claim is made of.
+        from tpu_dp.train.step import default_guard_in
+
+        sentinel_step = _make_step(model, opt, mesh, sched, use_pallas,
+                                   update_sharding, sentinel=True)
+        gstate = create_train_state(
+            model, jax.random.PRNGKey(0),
+            np.zeros((1, 32, 32, 3), np.float32), opt
+        )
+        gi = default_guard_in()
+        gstate, gm = sentinel_step(gstate, batches[0], gi)  # compile+warmup
+        float(gm["loss"])
+        gstate, gm = sentinel_step(gstate, batches[1 % len(batches)], gi)
+        float(gm["loss"])
+
+        t0 = time.perf_counter()
+        for i in range(guard_steps):
+            state, m = step_exe(state, batches[i % len(batches)])
+            float(m["loss"])  # same per-step fence on both runs
+        plain_s = (time.perf_counter() - t0) / guard_steps
+
+        t0 = time.perf_counter()
+        for i in range(guard_steps):
+            gstate, gm = sentinel_step(gstate, batches[i % len(batches)], gi)
+            # The guard hook's per-window fetch: loss_raw/grad_norm/applied.
+            float(gm["loss_raw"]), float(gm["grad_norm"]), int(gm["applied"])
+        sentinel_s = (time.perf_counter() - t0) / guard_steps
+        guard_rec = {
+            "n_steps": guard_steps,
+            "ms_per_step_plain": round(plain_s * 1e3, 3),
+            "ms_per_step_sentinel": round(sentinel_s * 1e3, 3),
+            "overhead_pct": round((sentinel_s / plain_s - 1.0) * 100, 2),
+        }
+
     serve_rec = None
     n_serve = int(cfg.get("serve_requests", 0))
     if n_serve > 0:
@@ -595,6 +640,8 @@ def measure_point(cfg: dict) -> dict:
             rec["latency"] = latency_rec
         if snapshot_rec is not None:
             rec["snapshot"] = snapshot_rec
+        if guard_rec is not None:
+            rec["guard"] = guard_rec
         if serve_rec is not None:
             rec["serve"] = serve_rec
         return rec
@@ -759,6 +806,12 @@ def main() -> None:
                     help="also measure async-snapshot overhead at this step "
                          "cadence (tpu_dp.resilience.SnapshotManager; the "
                          "record gains a 'snapshot' block with overhead_pct)")
+    ap.add_argument("--guard-overhead", type=int, default=0, metavar="N",
+                    help="also measure the guardrail sentinel's overhead "
+                         "over N fenced steps (plain vs sentinel program + "
+                         "the guard's per-window health fetch; the record "
+                         "gains a 'guard' block with overhead_pct — "
+                         "per-step path only, docs/RESILIENCE.md)")
     ap.add_argument("--probe-timeout", type=float, default=45.0,
                     help="FIRST probe attempt's timeout (seconds); later "
                          "attempts double it, capped at 360s — exponential "
@@ -826,6 +879,7 @@ def main() -> None:
             "model": args.model, "fused_stages": args.fused_stages,
             "fused_block_b": args.fused_block_b, "fused_bwd": args.fused_bwd,
             "snapshot_every": args.snapshot_every,
+            "guard_overhead_steps": args.guard_overhead,
             "latency_steps": args.latency_steps,
             "update_sharding": args.update_sharding,
             "serve_requests": args.serve_requests if args.serve else 0,

@@ -139,11 +139,48 @@ def test_shipped_sharded_steps_have_scatter_update_gather_schedule(repo_hlo):
             name, scatter_groups, gather_groups)
         assert all(op["reduction"] == "add"
                    for op in by_kind["reduce-scatter"]), name
-        # Every all-reduce left is a declared metric scalar.
-        assert len(by_kind["all-reduce"]) == rec["metric_allreduce_ops"] == 2
+        # Every all-reduce left is a declared metric scalar: 2 for the
+        # plain programs, 3 with the sentinel (its cross-shard grad-norm
+        # psum is the one collective guardrails add — see
+        # `test_sentinel_programs_in_artifact`).
+        declared = 3 if "sentinel" in name else 2
+        assert len(by_kind["all-reduce"]) == rec["metric_allreduce_ops"] \
+            == declared, (name, rec["metric_allreduce_ops"])
         assert rec["grad_reduce_ops"] == len(by_kind["reduce-scatter"]) >= 1
         # Donation survives the sharded layout: opt-state shards alias too.
         assert rec["aliased_inputs"] == rec["donated_inputs"] > 0, name
+
+
+def test_sentinel_programs_in_artifact(repo_hlo):
+    """The guardrail sentinel variants are fingerprinted alongside the
+    plain steps (docs/RESILIENCE.md "Guardrails"): replicated/GSPMD
+    sentinels add ZERO collectives (health computed from already-reduced
+    gradients — same 2 metric scalars, all-reduce-only schedule), the
+    sharded sentinel adds exactly ONE scalar psum (the cross-shard
+    grad-norm sum), and donation survives the guarded select in every
+    variant (the skip path's jnp.where must not cost double params
+    memory)."""
+    _, artifact = repo_hlo
+    progs = artifact["programs"]
+    sentinel = {k: v for k, v in progs.items() if "sentinel" in k}
+    assert set(sentinel) == {
+        "train_step[gspmd,sentinel]@accum1",
+        "train_step[shard_map,sentinel]@accum1",
+        "train_step[shard_map,sharded,sentinel]@accum1",
+        "multi_step[sentinel]@w2",
+    }
+    for name, rec in sentinel.items():
+        assert rec["aliased_inputs"] == rec["donated_inputs"] > 0, name
+        if rec["update_sharding"] == "sharded":
+            assert rec["metric_allreduce_ops"] == 3, name
+        else:
+            assert set(rec["counts"]) <= {"all-reduce"}, (name, rec["counts"])
+            assert rec["metric_allreduce_ops"] == 2, name
+    # The sharded sentinel's extra scalar is fingerprint-visible: a
+    # guard-enabled rank cannot impersonate a guard-off one (DP304 would
+    # catch the config divergence before the first deadlocked collective).
+    assert (sentinel["train_step[shard_map,sharded,sentinel]@accum1"]["digest"]
+            != progs["train_step[shard_map,sharded]@accum1"]["digest"])
 
 
 def test_fingerprint_distinguishes_update_sharding_modes(repo_hlo):

@@ -514,6 +514,7 @@ import os, pickle, sys
 rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
 ckpt = sys.argv[4]; out_path = sys.argv[5]; fault = sys.argv[6]
 update_sharding = sys.argv[7]; train_size = int(sys.argv[8])
+guard = len(sys.argv) > 9 and sys.argv[9] == "guard"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -541,6 +542,16 @@ cfg.resilience.regroup_timeout_s = 60
 cfg.parallel.coordinator_address = f"127.0.0.1:{port}"
 cfg.parallel.num_processes = world
 cfg.parallel.process_id = rank
+if guard:
+    # Guardrail twin of the elastic run: per-step snapshots give the SDC
+    # rollback a trusted pre-corruption resume point, the per-step audit
+    # bounds detection latency to one boundary, and spike detection stays
+    # unarmed (min_steps > run length) so only the audit drives events.
+    cfg.guard.enabled = True
+    cfg.guard.action = "skip"
+    cfg.guard.sdc_every_steps = 1
+    cfg.guard.spike_min_steps = 64
+    cfg.resilience.snapshot_every_steps = 1
 
 tr = Trainer(cfg)
 try:
@@ -634,7 +645,7 @@ def _elastic_oracle_params(record: dict, *, world0=3, num_examples,
 
 
 def _run_elastic_workers(tmp_path, fault, update_sharding="replicated",
-                         train_size=48):
+                         train_size=48, guard=False):
     port = _free_port()
     outs = [tmp_path / f"el{rank}.pkl" for rank in range(3)]
     script = tmp_path / "elastic_worker.py"
@@ -650,7 +661,7 @@ def _run_elastic_workers(tmp_path, fault, update_sharding="replicated",
         subprocess.Popen(
             [sys.executable, str(script), str(rank), "3", port,
              str(tmp_path / "ck"), str(outs[rank]), fault, update_sharding,
-             str(train_size)],
+             str(train_size)] + (["guard"] if guard else []),
             cwd=repo_root, env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
@@ -799,6 +810,53 @@ def test_three_process_elastic_external_sigterm_rank0(tmp_path):
     assert regroups[0]["world"] == 2
     assert [m["membership_epoch"] for m in metrics
             if "epoch" in m and m.get("membership_epoch") == 1]
+
+
+@pytest.mark.slow
+@pytest.mark.elastic
+@pytest.mark.guard
+def test_three_process_sdc_audit_names_rank2_and_regroups(tmp_path):
+    """The guardrail SDC acceptance run (ISSUE 8): 3 CPU processes, a
+    deterministic single-bit param flip on rank 2 at step 2
+    (``TPU_DP_FAULT=sdc:step=2,rank=2``). The per-boundary cross-replica
+    audit catches the divergence at the next boundary and NAMES rank 2
+    (majority vote over the bit-checksums, down to the leaf); rank 2
+    hands itself to the membership ledger (leave + rollback flavor) and
+    exits 143, the survivors regroup to world 2, resume from the newest
+    snapshot that PREDATES the corruption (post-detection snapshots are
+    suppressed, pre-detection ones quarantine-marked), and finish both
+    epochs matching the single-device oracle — corruption detected,
+    attributed, evicted, and rewound away with zero operator action."""
+    procs, outs = _run_elastic_workers(
+        tmp_path, "sdc:step=2,rank=2", train_size=96, guard=True)
+    results, logs = _assert_elastic_outcome(procs, outs, victim=2)
+    record = _assert_elastic_run(results, victim=2, num_examples=96)
+    # Rollback regroup (never graceful: a graceful final snapshot would
+    # persist the corrupt state), resumed at or before the flip step.
+    assert record["reason"] == "rollback"
+    assert record["resume"]["lineage"][0][1] <= 2
+    # The audit named rank 2 (the attribution line is rank-0-gated; every
+    # rank's detection is asserted via its counters below).
+    assert any("suspect rank(s) [2]" in log for log in logs)
+    # ... and the survivors' counters carry the audit trail.
+    for sid in sorted(results):
+        c = results[sid]["counters"]
+        assert c["guard.sdc_mismatches"] >= 1
+        assert c["guard.sdc_audits"] >= 1
+    # The eviction is attributed in the membership record's suspect reason.
+    assert any("sdc" in d.get("reason", "").lower()
+               for d in record["departed"])
+    # The quarantine ledger holds the finding with rank attribution.
+    recs = [json.loads(line) for line in
+            (tmp_path / "ck" / "quarantine.jsonl").read_text().splitlines()]
+    sdc = [r for r in recs if r["kind"] == "sdc"]
+    assert sdc and sdc[0]["suspects"] == [2]
+    assert sdc[0]["leaves"]["2"]  # leaf-level attribution present
+    # The guard_sdc event reached the metrics stream too.
+    metrics = [json.loads(line) for line in
+               (tmp_path / "ck" / "metrics.jsonl").read_text().splitlines()]
+    ev = [m for m in metrics if m.get("event") == "guard_sdc"]
+    assert ev and ev[0]["suspects"] == [2]
 
 
 @pytest.mark.slow

@@ -30,6 +30,19 @@
 #                                    # -m elastic tests (protocol units
 #                                    # AND the 3-process subprocess
 #                                    # suite).
+#   tools/run_tier1.sh --guard       # guardrails lane: two exit-coded
+#                                    # smokes — NaN-skip (injected
+#                                    # nan:step=3, action=skip: the run
+#                                    # must complete with exactly one
+#                                    # quarantine record) and
+#                                    # spike-rollback (injected 1e6x
+#                                    # spike, action=rollback: the run
+#                                    # must rewind to a snapshot,
+#                                    # tombstone, replay, and complete) —
+#                                    # archiving artifacts/
+#                                    # quarantine.jsonl + artifacts/
+#                                    # guard_report.json, then the
+#                                    # -m guard tests.
 #   tools/run_tier1.sh --serve       # serving lane: a 200-request mixed-
 #                                    # size synthetic load through the full
 #                                    # queue → batcher → compiled-forward
@@ -102,6 +115,57 @@ if [ "${1:-}" = "--elastic" ]; then
     mkdir -p artifacts
     env JAX_PLATFORMS=cpu python tools/elastic_smoke.py || exit $?
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m elastic \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--guard" ]; then
+    # Both smokes are their own verdict: train.py exits non-zero on any
+    # guard failure, and the jq-free python checks pin the artifacts the
+    # lane archives (quarantine records, rollback tombstones).
+    mkdir -p artifacts
+    SMOKE=$(mktemp -d /tmp/tpu_dp_guard_smoke.XXXXXX) || exit 1
+    env JAX_PLATFORMS=cpu python train.py \
+        --data.dataset=synthetic --data.synthetic_train_size=48 \
+        --data.synthetic_test_size=16 --data.batch_size=4 \
+        --train.epochs=1 --train.log_every=100 --train.eval_at_end=false \
+        --train.steps_per_call=1 --parallel.num_devices=1 \
+        --train.ckpt_dir="$SMOKE/skip" \
+        --guard.enabled=true --guard.action=skip \
+        --resilience.fault=nan:step=3 > "$SMOKE/skip.out" || exit $?
+    env JAX_PLATFORMS=cpu python train.py \
+        --data.dataset=synthetic --data.synthetic_train_size=128 \
+        --data.synthetic_test_size=16 --data.batch_size=4 \
+        --train.epochs=2 --train.log_every=100 --train.eval_at_end=false \
+        --train.steps_per_call=1 --parallel.num_devices=1 \
+        --train.ckpt_dir="$SMOKE/roll" --train.ckpt_async=false \
+        --resilience.snapshot_every_steps=5 \
+        --guard.enabled=true --guard.action=rollback \
+        --guard.spike_min_steps=4 --guard.spike_z=12 \
+        --resilience.fault=spike:step=8,scale=1e6 \
+        > "$SMOKE/roll.out" || exit $?
+    env JAX_PLATFORMS=cpu python - "$SMOKE" <<'PY' || exit 1
+import json, sys
+from pathlib import Path
+smoke = Path(sys.argv[1])
+skip = [json.loads(l) for l in (smoke/"skip/quarantine.jsonl").read_text().splitlines()]
+assert [r["kind"] for r in skip] == ["quarantine"], skip
+roll = [json.loads(l) for l in (smoke/"roll/quarantine.jsonl").read_text().splitlines()]
+assert "tombstone" in [r["kind"] for r in roll], roll
+report = {
+    "skip": json.loads((smoke/"skip.out").read_text().strip().splitlines()[-1])["guard"],
+    "rollback": json.loads((smoke/"roll.out").read_text().strip().splitlines()[-1])["guard"],
+}
+assert report["skip"]["quarantined"] == 1, report
+assert report["rollback"]["rollbacks"] >= 1, report
+out = Path("artifacts")
+(out/"guard_report.json").write_text(json.dumps(report, indent=2) + "\n")
+merged = (smoke/"skip/quarantine.jsonl").read_text() + (smoke/"roll/quarantine.jsonl").read_text()
+(out/"quarantine.jsonl").write_text(merged)
+print("guard smoke:", json.dumps(report))
+PY
+    rm -rf "$SMOKE"
+    echo "guard smoke: artifacts/quarantine.jsonl + artifacts/guard_report.json"
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m guard \
         -p no:cacheprovider
 fi
 

@@ -522,6 +522,47 @@ def shipped_programs(
         (state, _example_batch(batch)),
         spec(step_mod.make_eval_step, 0, 2, False),
     )
+    # The guardrail sentinel variants (guard.enabled, docs/RESILIENCE.md
+    # "Guardrails"): the same programs with the on-device health summary +
+    # guarded update compiled in and the replicated guard_in input. The
+    # replicated/GSPMD schedules are unchanged (the health summary is
+    # computed from already-reduced gradients — same 2 metric scalars);
+    # the sharded path adds exactly ONE scalar psum (the cross-shard
+    # grad-norm sum — the only collective the sentinel ever adds), hence
+    # metric_reductions=3 there. Registering them keeps DP301–DP304 the
+    # safety net for guard-enabled runs; with the sentinel off the
+    # non-sentinel programs above must stay digest-identical across PRs.
+    gi = step_mod.default_guard_in()
+    yield (
+        "train_step[gspmd,sentinel]@accum1",
+        step_mod.make_train_step(model, opt, mesh, sched, sentinel=True),
+        (state, _example_batch(batch), gi),
+        spec(step_mod.make_train_step, n_state, 2, True),
+    )
+    yield (
+        "train_step[shard_map,sentinel]@accum1",
+        step_mod.make_train_step_shard_map(model, opt, mesh, sched,
+                                           sentinel=True),
+        (state, _example_batch(batch), gi),
+        spec(step_mod.make_train_step_shard_map, n_state, 2, True),
+    )
+    yield (
+        "train_step[shard_map,sharded,sentinel]@accum1",
+        step_mod.make_train_step_shard_map(
+            model, sharded_opt, mesh, sched, update_sharding="sharded",
+            sentinel=True,
+        ),
+        (sharded_state, _example_batch(batch), gi),
+        spec(step_mod.make_train_step_shard_map, n_state, 3, True,
+             mode="sharded"),
+    )
+    yield (
+        "multi_step[sentinel]@w2",
+        step_mod.make_multi_step(model, opt, mesh, sched, num_steps=2,
+                                 sentinel=True),
+        (state, _example_batch(batch, (2,)), gi),
+        spec(step_mod.make_multi_step, n_state, 2, True),
+    )
     # The serving forwards (`tpu_dp.serve`, docs/SERVING.md): one program
     # per batch bucket, donating the ServeStats pytree (2 leaves — DP303
     # must prove the aliasing for serving too). A bucket divisible by the

@@ -69,6 +69,12 @@ def has_cross_process_leaves(tree) -> bool:
     )
 
 
+#: Advisory marker the guardrail layer drops into a step dir it distrusts
+#: (`tpu_dp.resilience.preempt.quarantine_save_dir`); defined here so the
+#: write protocol can clear a stale one without importing resilience.
+QUARANTINED_MARKER = "quarantined.json"
+
+
 def _atomic_write_state(
     ckpt_dir: Path, host_state, meta: dict[str, Any] | None
 ) -> Path:
@@ -81,6 +87,15 @@ def _atomic_write_state(
     meta_tmp = ckpt_dir / (_META_NAME + ".tmp")
     meta_tmp.write_text(json.dumps(meta or {}, indent=2, default=str))
     os.replace(meta_tmp, ckpt_dir / _META_NAME)
+    # A fresh complete write into this dir supersedes any quarantine
+    # suspicion on its previous contents: a post-rollback replay re-saves
+    # CLEAN state into the same step_<n> dirs (same atomic protocol), and
+    # a surviving marker would keep `find_candidates` distrusting a save
+    # that no longer carries the condemned bytes.
+    try:
+        (ckpt_dir / QUARANTINED_MARKER).unlink()
+    except FileNotFoundError:
+        pass
     return ckpt_dir / _CKPT_NAME
 
 

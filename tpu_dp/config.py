@@ -196,6 +196,59 @@ class ResilienceConfig:
 
 
 @dataclass
+class GuardConfig:
+    """Training guardrails (tpu_dp/resilience/guard.py, docs/RESILIENCE.md
+    "Guardrails"): on-device NaN/divergence sentinel, bad-batch quarantine,
+    cross-replica SDC audit, auto-rollback."""
+
+    # Master switch: compiles the sentinel (on-device health summary +
+    # guarded update) into the step programs and runs the policy engine at
+    # window boundaries. Off (default), every compiled program is
+    # bit-for-bit the unguarded one (DP304 digests identical) and zero
+    # host work is added.
+    enabled: bool = False
+    # Response to a triggered detector: "skip" quarantines the batch (the
+    # update is withheld on-device — non-finite always, spiking when the
+    # armed loss cap catches it — and the sampler schedule stays
+    # exactly-once); "rollback" rewinds to the newest complete snapshot;
+    # "halt" raises DivergedError (exit 65, distinct from the preemption
+    # 143 so supervisors do NOT auto-restart into the same divergence);
+    # "warn" records and keeps going.
+    action: str = "skip"  # warn | skip | rollback | halt
+    # Spike detector: robust z-score (|x - median| / (1.4826 * MAD)) on
+    # loss and grad-norm over the trailing window of applied steps;
+    # detection arms after spike_min_steps observations.
+    spike_window: int = 64
+    spike_z: float = 8.0
+    spike_min_steps: int = 16
+    # Under action=skip, also arm the on-device loss cap (median + z*MAD
+    # from the previous window) so a spiking batch's update is withheld
+    # inside the compiled step instead of detected after it applied.
+    device_cap: bool = True
+    # Consecutive rollbacks without progress past the previous high-water
+    # step before the policy escalates to halt (a deterministic divergence
+    # replays identically; rolling back into it forever is a livelock).
+    max_rollbacks: int = 3
+    # LR ease-in after a rollback: scale the scheduled LR from
+    # lr_ease_start back to 1.0 linearly over lr_ease_steps replayed
+    # steps (0 = replay at full LR).
+    lr_ease_steps: int = 0
+    lr_ease_start: float = 0.1
+    # Cross-replica SDC audit cadence in optimizer steps (0 = off): params
+    # bit-checksummed on-device and compared across ranks over the DP304
+    # fingerprint transport; a mismatching rank is attributed by majority
+    # vote (and, when resilience.elastic is on, evicted through the
+    # membership ledger with a rollback resume past its corruption).
+    sdc_every_steps: int = 0
+    # Non-elastic response to an SDC mismatch: "halt" (default — corrupt
+    # replicas poison every peer through the gradient collective) or
+    # "warn" (record and keep going; for diagnosis only).
+    sdc_action: str = "halt"  # warn | halt
+    # quarantine.jsonl sink ("" = <train.ckpt_dir>/quarantine.jsonl).
+    quarantine_path: str = ""
+
+
+@dataclass
 class ServeConfig:
     """Batched-inference serving (tpu_dp/serve/, docs/SERVING.md)."""
 
@@ -237,6 +290,7 @@ class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    guard: GuardConfig = field(default_factory=GuardConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
 

@@ -104,6 +104,7 @@ class HeartbeatWriter:
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._f = open(self.path, "a", encoding="utf-8")
         self._last_step: int | None = None
+        self.generation = 0  # bumped by rewind() after a guard rollback
 
     def beat(self, step: int, step_ms: float, ts: float | None = None) -> bool:
         """Append one heartbeat; returns False when throttled away."""
@@ -119,9 +120,24 @@ class HeartbeatWriter:
             "ts": time.time() if ts is None else float(ts),
             "step_ms": round(float(step_ms), 3),
         }
+        if self.generation:
+            # Replayed steps are distinguishable from their first attempt:
+            # post-hoc attribution (`HealthMonitor.scan`) keeps the
+            # highest-generation record per (rank, step) instead of
+            # double-counting the rolled-back pass.
+            rec["gen"] = self.generation
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
         return True
+
+    def rewind(self, step: int) -> None:
+        """Un-throttle after a rollback rewound the step clock below beats
+        already written: without this, `beat` would stay silent for the
+        whole replay window (step <= the pre-rollback high-water mark) and
+        the monitor would read healthy replaying ranks as hung. Bumps the
+        generation stamped on every subsequent record."""
+        self._last_step = None
+        self.generation += 1
 
     def close(self) -> None:
         if not self._f.closed:
@@ -224,12 +240,16 @@ class HealthMonitor:
         return out
 
     def latest(self) -> dict[int, dict]:
-        """The newest beat per rank (highest step wins; file order ties).
+        """The newest beat per rank (highest (generation, step) wins; file
+        order ties). The generation key first: after a guard rollback the
+        replay legitimately beats at LOWER steps than the rolled-back
+        pass, and judging liveness by the stale pre-rollback high-water
+        beat would flag every healthy replaying rank.
 
         Tail-bounded read (`TAIL_BYTES`): the live check only needs each
         rank's newest line, never the full history."""
         return {
-            rank: max(beats, key=lambda b: b["step"])
+            rank: max(beats, key=lambda b: (b.get("gen", 0), b["step"]))
             for rank, beats in self.read_beats(
                 tail_bytes=self.TAIL_BYTES
             ).items()
@@ -290,7 +310,9 @@ class HealthMonitor:
                 ))
         fresh: dict[int, dict] = {}
         for rank, beats in sorted(by_rank.items()):
-            ordered = sorted(beats, key=lambda b: b["step"])
+            # (generation, step): a post-rollback replay's beats outrank
+            # the rolled-back pass even at lower step numbers.
+            ordered = sorted(beats, key=lambda b: (b.get("gen", 0), b["step"]))
             b = ordered[-1]
             age = now - b["ts"]
             interval = (
@@ -312,11 +334,18 @@ class HealthMonitor:
         """Post-hoc attribution over the full history: for every step at
         which ≥ 2 ranks reported, flag ranks whose step time exceeded
         ``straggler_factor ×`` that step's cross-rank median — "which rank
-        made step K slow", answered from the files alone."""
+        made step K slow", answered from the files alone.
+
+        Steps replayed after a guard rollback appear once: per (rank,
+        step) only the highest-generation record (the surviving attempt)
+        enters the attribution — rolled-back work is never double-counted.
+        """
         by_step: dict[int, dict[int, dict]] = {}
         for rank, beats in self.read_beats().items():
             for b in beats:
-                by_step.setdefault(b["step"], {})[rank] = b
+                cur = by_step.setdefault(b["step"], {}).get(rank)
+                if cur is None or b.get("gen", 0) >= cur.get("gen", 0):
+                    by_step[b["step"]][rank] = b
         issues: list[HealthIssue] = []
         for step in sorted(by_step):
             issues.extend(self._straggler_issues(by_step[step]))

@@ -511,6 +511,25 @@ def membership_barrier(tag: str, epoch: int, timeout_s: float = 60.0) -> None:
     )
 
 
+def cross_rank_gather(payload: np.ndarray) -> np.ndarray:
+    """Host-level allgather of one small per-process array.
+
+    The shared transport behind the DP304 fingerprint check and the
+    guardrail layer's SDC audit (`tpu_dp.resilience.guard`): every process
+    contributes its local ``payload`` (fixed shape/dtype across ranks) and
+    receives the ``[world, ...]`` stack — an allgather, not a broadcast,
+    because EVERY rank must be able to see a divergence and act on it
+    (rank attribution, self-eviction), not just rank 0. Single-process:
+    the stack of one, so callers never special-case.
+    """
+    arr = np.asarray(payload)
+    if jax.process_count() == 1:
+        return arr[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
 def verify_collective_fingerprint(digest: str, tag: str = "train_step") -> str:
     """Fail fast when ranks are about to run different collective schedules.
 
@@ -530,14 +549,12 @@ def verify_collective_fingerprint(digest: str, tag: str = "train_step") -> str:
         raise ValueError(f"not a sha256 hex digest: {digest!r}")
     if jax.process_count() == 1:
         return digest
-    from jax.experimental import multihost_utils
-
     # Allgather, not broadcast: EVERY rank must see the mismatch and raise.
     # (With a rank-0 broadcast, only the divergent rank would die — rank 0
     # would sail past the check and hang at its first collective waiting
     # for the dead peer, the exact deadlock this hook exists to prevent.)
     mine = np.frombuffer(bytes.fromhex(digest), dtype=np.uint8).copy()
-    gathered = np.asarray(multihost_utils.process_allgather(mine))
+    gathered = cross_rank_gather(mine)
     bad = [r for r in range(gathered.shape[0])
            if not np.array_equal(gathered[r], gathered[0])]
     if bad:

@@ -11,8 +11,12 @@ individually usable:
   `resume_latest` to restore the newest complete state;
 - `retry` — bounded exponential-backoff retry + `PeerFailedError` with
   rank attribution, wrapping the native host-ring collectives;
-- `faultinject` — deterministic kill/preempt/delay/drop/leave injection
-  for the resilience test suite (`tests/test_resilience.py`);
+- `faultinject` — deterministic kill/preempt/delay/drop/leave/nan/spike/
+  sdc injection for the resilience + guardrail test suites;
+- `guard` — training guardrails against the *quiet* failures: divergence
+  policy engine (non-finite + median/MAD spike detection, escalating
+  skip/rollback/halt actions), bad-batch quarantine ledger, cross-replica
+  SDC audit with rank attribution, typed `DivergedError` (exit 65);
 - `elastic` — membership-epoch regroup: a preempted rank shrinks the mesh
   to the survivors (shared-filesystem ledger rendezvous, re-`initialize`
   at world N-1, checkpoint reshard, mid-epoch sampler re-split, DP304
@@ -22,6 +26,13 @@ See docs/RESILIENCE.md for the snapshot format and the preemption/resume
 contract.
 """
 
+from tpu_dp.resilience.guard import (
+    DIVERGED_EXIT_CODE,
+    DivergedError,
+    GuardPolicy,
+    GuardTrigger,
+    QuarantineLog,
+)
 from tpu_dp.resilience.elastic import (
     MEMBERSHIP_SCHEMA,
     ElasticCoordinator,
@@ -37,10 +48,12 @@ from tpu_dp.resilience.faultinject import (
 )
 from tpu_dp.resilience.preempt import (
     PREEMPTED_EXIT_CODE,
+    QUARANTINED_MARKER,
     PreemptedError,
     PreemptionHandler,
     find_candidates,
     find_latest,
+    quarantine_save_dir,
     resume_latest,
 )
 from tpu_dp.resilience.retry import (
@@ -52,11 +65,16 @@ from tpu_dp.resilience.retry import (
 from tpu_dp.resilience.snapshot import SnapshotManager
 
 __all__ = [
+    "DIVERGED_EXIT_CODE",
+    "DivergedError",
     "ElasticCoordinator",
     "ElasticError",
     "FaultInjector",
     "FaultPlan",
+    "GuardPolicy",
+    "GuardTrigger",
     "KILL_EXIT_CODE",
+    "QuarantineLog",
     "MEMBERSHIP_SCHEMA",
     "MembershipLedger",
     "MembershipRecord",
@@ -64,12 +82,14 @@ __all__ = [
     "PeerFailedError",
     "PreemptedError",
     "PreemptionHandler",
+    "QUARANTINED_MARKER",
     "QuiescePlan",
     "ResilientRing",
     "SnapshotManager",
     "backoff_delays",
     "find_candidates",
     "find_latest",
+    "quarantine_save_dir",
     "resume_latest",
     "retry_call",
 ]

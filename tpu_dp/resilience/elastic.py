@@ -392,12 +392,17 @@ class MembershipLedger:
         plan = QuiescePlan(
             epoch=epoch,
             flavor="rollback" if rollback else "graceful",
-            # Graceful: the stop THRESHOLD (see QuiescePlan) — far enough
-            # that no still-stepping member can overshoot it before its
-            # next plan poll; a lone member has nobody to overshoot, so it
-            # stops where it is. Rollback: informational only.
+            # The stop THRESHOLD (see QuiescePlan) — far enough that no
+            # still-stepping member can overshoot it before its next plan
+            # poll; a lone member has nobody to overshoot, so it stops
+            # where it is. It applies to EVERY plan whose members are all
+            # alive — including a live-membered rollback (an SDC eviction:
+            # the corrupt rank leaves, nobody died): stopping one rank
+            # "immediately" while healthy peers still dispatch collectives
+            # would wedge the mesh. Only a plan with DEPARTED members
+            # (stepping already impossible) stops where it stands.
             stop_step=(max_step + 2 * max_window + 1)
-            if not rollback and len(members) > 1 else max_step,
+            if not departed and len(members) > 1 else max_step,
             train_epoch=train_epoch,
             leavers=leavers,
             departed=tuple(departed),
@@ -502,6 +507,13 @@ class ElasticCoordinator:
         self.ledger.mark_suspect(
             self.record.epoch + 1, self.record.members[rank], reason
         )
+
+    def rewind_poll(self, host_step: int) -> None:
+        """Re-arm the rate-limited ledger poll after a guard rollback
+        rewound the step clock (same contract as `SnapshotManager.rewind`):
+        the crossing marker must not sit at the pre-rollback high-water
+        step, or peer/suspect detection is suppressed for the replay."""
+        self._poll_marker = int(host_step)
 
     # -- quiesce --------------------------------------------------------
 
@@ -613,12 +625,18 @@ class ElasticCoordinator:
                 # held-socket handoff isn't possible through the runtime's
                 # service constructor, which takes an address string.
                 coordinator = f"{host}:{free_port(host)}"
+            # A leaver that was also ACCUSED (suspect file for this
+            # transition — e.g. the SDC audit's self-eviction) carries the
+            # accusation as its reason; a plain preemption stays labelled
+            # as such.
+            suspects = self.ledger.suspects(plan.epoch)
             rec = MembershipRecord(
                 epoch=plan.epoch, members=plan.survivors,
                 coordinator=coordinator,
                 departed=tuple(
                     list(plan.departed)
-                    + [{"sid": s, "reason": "preempted (graceful)"}
+                    + [{"sid": s,
+                        "reason": suspects.get(s, "preempted (graceful)")}
                        for s in plan.leavers]
                 ),
                 resume=resume, reason=plan.flavor, ts=time.time(),

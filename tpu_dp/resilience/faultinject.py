@@ -23,10 +23,28 @@ the latter so spawned worker processes inherit the plan)::
                              # non-main threads)
     delay:step=5,ms=250      # sleep 250ms once (straggler simulation)
     drop:step=7              # arm a one-shot collective drop (ring retry path)
+    nan:step=4               # guardrail faults (require guard.enabled —
+    nan:step=4,rank=1        # the injection seam is compiled into the
+    spike:step=4,scale=1e4   # sentinel step): at optimizer step K the loss
+    sdc:step=4,rank=2        # and gradients are multiplied by NaN (nan:)
+    sdc:step=4,rank=2,leaf=conv1/* # or by a large finite scale (spike:)
+                             # INSIDE the device program; sdc: flips the
+                             # top exponent bit of the params leaves
+                             # matching the ``leaf=`` glob (default: the
+                             # first leaf) on the target rank's local
+                             # replica AFTER the step boundary — the
+                             # silent-data-corruption twin the
+                             # cross-replica audit must catch.
 
 With multi-step windows the host observes step counts only at window
 boundaries, so "at step K" means the first boundary where the global step
-reached K — deterministic for a fixed window size.
+reached K — deterministic for a fixed window size. The device-seam faults
+(``nan:``/``spike:``) fire at ``state.step == K`` inside the program and
+are disarmed at the first boundary past K; because a skipped (quarantined)
+update freezes the device step counter, a window that packs several steps
+past K would poison them all — pin ``train.steps_per_call=1`` for
+single-step determinism (the guard test suite does). The full grammar is
+documented once, in docs/RESILIENCE.md "Fault-injection spec".
 """
 
 from __future__ import annotations
@@ -39,7 +57,11 @@ import time
 
 logger = logging.getLogger(__name__)
 
-_KINDS = ("kill", "preempt", "delay", "drop", "leave")
+_KINDS = ("kill", "preempt", "delay", "drop", "leave", "nan", "spike", "sdc")
+#: kinds the Trainer handles through the guardrail layer rather than
+#: `on_step`: nan/spike ride the sentinel's compiled injection seam
+#: (`train/step._inject_guard_fault`), sdc mutates the host-side params.
+GUARD_KINDS = ("nan", "spike", "sdc")
 #: exit code for an injected hard kill — SIGKILL's 128+9, the signature of
 #: a host OOM-killer / preemption-without-grace death.
 KILL_EXIT_CODE = 137
@@ -47,10 +69,12 @@ KILL_EXIT_CODE = 137
 
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
-    kind: str          # kill | preempt | delay | drop
+    kind: str          # kill | preempt | delay | drop | leave | nan | spike | sdc
     step: int          # global optimizer step the fault fires at (>=)
     rank: int = -1     # -1: every rank
     delay_ms: float = 0.0
+    scale: float = 0.0  # spike: multiplier applied to loss/grads
+    leaf: str = ""      # sdc: glob over params leaf paths ("" = first leaf)
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan | None":
@@ -65,18 +89,26 @@ class FaultPlan:
                 f"expected one of {_KINDS}"
             )
         fields: dict[str, float] = {}
+        leaf = ""
         for item in filter(None, rest.split(",")):
             key, eq, val = item.partition("=")
-            if not eq or key not in ("step", "rank", "ms"):
+            if not eq or key not in ("step", "rank", "ms", "scale", "leaf"):
                 raise ValueError(f"bad fault field {item!r} in {spec!r}")
-            fields[key] = float(val)
+            if key == "leaf":
+                leaf = val
+            else:
+                fields[key] = float(val)
         if "step" not in fields:
             raise ValueError(f"fault spec {spec!r} needs step=<n>")
+        if kind == "spike" and "scale" not in fields:
+            raise ValueError(f"fault spec {spec!r} needs scale=<s>")
         return cls(
             kind=kind,
             step=int(fields["step"]),
             rank=int(fields.get("rank", -1)),
             delay_ms=float(fields.get("ms", 0.0)),
+            scale=float(fields.get("scale", 0.0)),
+            leaf=leaf,
         )
 
 
@@ -115,6 +147,11 @@ class FaultInjector:
         honest simulation of a yanked host). The other kinds return after
         their side effect.
         """
+        if self.plan.kind in GUARD_KINDS:
+            # nan/spike are compiled into the sentinel step (armed through
+            # `device_fault`), sdc is a host-side params mutation the
+            # Trainer owns — firing them here would be a no-op at best.
+            return
         if not self._due(global_step):
             return
         self.fired = True
@@ -152,3 +189,36 @@ class FaultInjector:
             self._drop_armed = False
             return True
         return False
+
+    # -- guardrail faults (docs/RESILIENCE.md "Fault-injection spec") ----
+
+    def device_fault(self) -> "FaultPlan | None":
+        """The armed ``nan:``/``spike:`` plan for this rank, or None.
+
+        The Trainer folds it into the sentinel's ``guard_in`` (the
+        compiled injection seam fires at ``state.step == plan.step``) and
+        disarms through `disarm_device` at the first boundary past it.
+        """
+        if self.fired or self.plan.kind not in ("nan", "spike"):
+            return None
+        if self.plan.rank >= 0 and self.plan.rank != self.rank:
+            return None
+        return self.plan
+
+    def disarm_device(self, global_step: int) -> None:
+        """One-shot the device seam: past the fault step, stop arming it
+        (the sentinel's frozen step counter cannot disarm itself).
+
+        Strictly past: the device fires while ``state.step == K``, which is
+        the window whose END boundary is host step K+1 — disarming at
+        ``>= K`` would strip the seam from the very window that fires it.
+        """
+        if self.plan.kind in ("nan", "spike") and global_step > self.plan.step:
+            self.fired = True
+
+    def take_sdc(self, global_step: int) -> "FaultPlan | None":
+        """Consume a due ``sdc:`` plan (the Trainer flips the param bit)."""
+        if self.plan.kind != "sdc" or not self._due(global_step):
+            return None
+        self.fired = True
+        return self.plan

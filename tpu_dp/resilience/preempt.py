@@ -36,6 +36,27 @@ logger = logging.getLogger(__name__)
 #: 128 + SIGTERM — the exit status of a graceful preemption shutdown.
 PREEMPTED_EXIT_CODE = 143
 
+#: Marker file the guardrail layer drops into a snapshot/checkpoint step
+#: dir it no longer trusts (written after an SDC audit named a corrupt
+#: replica: every save taken since the last clean audit may carry the
+#: corruption). `find_candidates` skips marked dirs, so a rollback or an
+#: auto-resume lands on the newest save that predates the suspicion. A
+#: fresh complete save into the dir clears the marker (the write protocol
+#: owns that — `checkpoint._atomic_write_state`).
+QUARANTINED_MARKER = ckpt_lib.QUARANTINED_MARKER
+
+
+def quarantine_save_dir(step_dir: Path, reason: str) -> None:
+    """Mark one save directory untrusted (idempotent, atomic-enough: the
+    marker is advisory metadata, not a consistency protocol)."""
+    import json
+    import time
+
+    path = Path(step_dir) / QUARANTINED_MARKER
+    if not path.exists():
+        path.write_text(json.dumps(
+            {"reason": reason, "ts": time.time()}) + "\n")
+
 
 class PreemptedError(RuntimeError):
     """Raised out of the training loop after a clean preemption shutdown."""
@@ -132,6 +153,11 @@ def find_candidates(ckpt_dir: str | Path,
         if root is None:
             continue
         for d in ckpt_lib.CheckpointManager(root).complete_dirs():
+            # Saves the guardrail layer marked untrusted after an SDC
+            # finding are not candidates: resuming a corrupted save
+            # "successfully" is the failure mode the audit exists to stop.
+            if (d / QUARANTINED_MARKER).exists():
+                continue
             ranked.append((_manager_step(d), priority, d))
     out = [(d, step) for step, _, d in
            sorted(ranked, key=lambda c: (c[0], c[1]), reverse=True)]

@@ -92,6 +92,16 @@ class SnapshotManager:
         prev = self._last_step if self._last_step >= 0 else 0
         return global_step // self.every_steps > prev // self.every_steps
 
+    def rewind(self, global_step: int) -> None:
+        """Reset the cadence marker after a rollback rewound the step clock.
+
+        Without this, `due` compares against the pre-rollback high-water
+        step and stays silent for the whole replay window — exactly the
+        stretch of training that just proved it needs snapshots. Replayed
+        snapshots land in the same ``step_<n>`` dirs (atomic overwrite).
+        """
+        self._last_step = int(global_step)
+
     def maybe(self, state, global_step: int,
               meta: dict[str, Any] | None = None) -> Path | None:
         """Snapshot iff the cadence is due; returns the path when taken."""

@@ -1,0 +1,484 @@
+"""Step-lifecycle hooks: the trainer's per-window extension seam.
+
+`Trainer.fit`/`train_epoch` had absorbed ~300 inline lines per subsystem —
+snapshot cadence, fault injection, heartbeats, step-ranged profiling, the
+elastic/preemption boundary — each spliced into the hot loop by hand
+(ROADMAP item 5). This module is the extraction: the loop now fires four
+fixed lifecycle points and every cross-cutting subsystem registers a
+:class:`StepHook` instead of editing the loop. The hot path cost is one
+list iteration per dispatched window; hooks that observe device values
+(the guardrail hook) pay their own fetch, hooks that don't add no syncs.
+
+Lifecycle (per `Trainer.train_epoch`):
+
+- ``on_epoch_start(epoch)`` — before the first window of an epoch;
+- ``on_window_start(first_step, n)`` — immediately before dispatching a
+  window covering optimizer steps ``[first_step, first_step + n)``;
+- ``on_step_end(ev)`` — after the window's metrics were accumulated and
+  the host step clock advanced (`StepEvent`); hooks here may raise the
+  trainer's control-flow exceptions (regroup, preemption, guard rollback,
+  `DivergedError`) — later hooks in the same sweep are skipped;
+- ``on_snapshot(epoch, done, step, meta)`` — after any snapshot commit
+  (cadence, preemption final, elastic quiesce final).
+
+Hook order is load-bearing and owned by `Trainer._build_hooks`:
+guardrails run FIRST (a window that triggers a rollback must not be
+snapshotted first — the just-written snapshot would become the "newest
+complete" rollback target and resurrect the very update being rewound),
+then snapshot cadence, then fault injection (a kill at step K lands after
+the step-K snapshot, preserving the kill/resume test contract), then
+heartbeats (an injected delay is attributed to the step it fired at),
+profiling, and the elastic/preemption boundary last (it raises on a
+transition, and everything before it must have run for the final state to
+be coherent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+from tpu_dp.obs.counters import counters as _obs_counters
+from tpu_dp.utils import log0
+
+
+@dataclasses.dataclass(frozen=True)
+class StepEvent:
+    """One dispatched window, observed at its end boundary."""
+
+    epoch: int   # dataset epoch
+    done: int    # epoch-cumulative optimizer steps incl. this window
+    n: int       # optimizer steps in this window
+    window: tuple  # per-step device metric dicts (fetch = host sync)
+
+
+class StepHook:
+    """Base hook: every lifecycle point a no-op; subclass what you need."""
+
+    def __init__(self, trainer):
+        self.tr = trainer
+
+    def on_epoch_start(self, epoch: int) -> None:
+        pass
+
+    def on_window_start(self, first_step: int, n: int) -> None:
+        pass
+
+    def on_step_end(self, ev: StepEvent) -> None:
+        pass
+
+    def on_snapshot(self, epoch: int, done: int, step: int,
+                    meta: dict[str, Any]) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class SnapshotHook(StepHook):
+    """Async step-cadence snapshots (`resilience.snapshot_every_steps`)."""
+
+    def on_step_end(self, ev: StepEvent) -> None:
+        tr = self.tr
+        if tr._sdc_suspect_active:
+            # An SDC audit flagged live divergence this run (guard hook,
+            # earlier in this very sweep): persisting the current state
+            # would mint a fresh "newest complete" save carrying the
+            # corruption — the exact artifact the rollback is about to go
+            # looking for. Snapshots stay off until the regroup/rollback
+            # re-establishes a trusted state.
+            log0("snapshot suppressed at step %d: SDC suspicion active",
+                 tr._host_step)
+            return
+        if tr.snap_mgr.due(tr._host_step):
+            tr._take_snapshot(ev.epoch, ev.done)
+
+
+class FaultHook(StepHook):
+    """Deterministic fault injection (`TPU_DP_FAULT`, tests only).
+
+    Fires the legacy step-boundary kinds (kill/preempt/delay/drop/leave),
+    applies a due ``sdc:`` params mutation, and disarms the device-seam
+    ``nan:``/``spike:`` plans once the boundary passed their step.
+    """
+
+    def on_step_end(self, ev: StepEvent) -> None:
+        tr = self.tr
+        if tr.fault is None:
+            return
+        tr.fault.on_step(tr._host_step)
+        plan = tr.fault.take_sdc(tr._host_step)
+        if plan is not None:
+            tr._inject_sdc(plan)
+        tr.fault.disarm_device(tr._host_step)
+
+
+class HeartbeatHook(StepHook):
+    """Per-rank liveness beats (`tpu_dp.obs.health.HeartbeatWriter`).
+
+    Boundary-to-boundary wall time per step since the last accepted beat.
+    Host-clock honesty: without fences (obs=basic) this is a dispatch
+    rate; sustained, backpressure makes it track the device rate.
+    """
+
+    def __init__(self, trainer):
+        super().__init__(trainer)
+        self._t_boundary = time.perf_counter()
+        self._steps = 0
+
+    def on_epoch_start(self, epoch: int) -> None:
+        self._t_boundary = time.perf_counter()
+        self._steps = 0
+
+    def on_step_end(self, ev: StepEvent) -> None:
+        tr = self.tr
+        if tr.heartbeat is None:
+            return
+        now = time.perf_counter()
+        self._steps += ev.n
+        try:
+            accepted = tr.heartbeat.beat(
+                tr._host_step, (now - self._t_boundary) / self._steps * 1e3
+            )
+        except OSError:
+            # Best-effort telemetry on a shared filesystem where transient
+            # errors (NFS blip, quota) are routine — a failed beat must
+            # never abort training. Logged once; the monitor sees the gap
+            # as staleness.
+            if not tr._hb_write_failed:
+                tr._hb_write_failed = True
+                log0("heartbeat write failed (suppressing further "
+                     "warnings)", exc_info=True)
+            accepted = False
+        if accepted:
+            self._t_boundary, self._steps = now, 0
+
+
+class ProfilerHook(StepHook):
+    """Step-ranged profiling (`train.profile_steps=START:END`)."""
+
+    def on_window_start(self, first_step: int, n: int) -> None:
+        # BEFORE dispatch: the window about to run is steps
+        # [first_step, first_step + n) — arming at the post-window
+        # boundary would trace the window after the requested range (and
+        # miss in-window ranges entirely).
+        if self.tr._step_profiler is not None:
+            self.tr._step_profiler.on_window_start(first_step, n)
+
+    def on_step_end(self, ev: StepEvent) -> None:
+        if self.tr._step_profiler is not None:
+            self.tr._step_profiler.on_step(self.tr._host_step)
+
+
+class BoundaryHook(StepHook):
+    """The elastic / preemption window boundary — always last.
+
+    Elastic on: SIGTERM means "this rank leaves, the job continues" — the
+    boundary runs detection/quiesce and raises `_RegroupSignal` (survivor)
+    or `PreemptedError` (leaver). Elastic off: a pending preemption signal
+    runs the snapshot-and-exit-143 contract.
+    """
+
+    def on_step_end(self, ev: StepEvent) -> None:
+        tr = self.tr
+        if tr.elastic is not None:
+            tr._elastic_boundary(ev.epoch, ev.done)
+        elif tr.preempt is not None and tr.preempt.requested:
+            tr._preempt_exit(ev.epoch, ev.done)
+
+
+class GuardHook(StepHook):
+    """Training guardrails (`tpu_dp.resilience.guard`, docs/RESILIENCE.md).
+
+    Owns the three guardrail loops end to end:
+
+    - **pre-dispatch** (`guard_in`): builds the sentinel's replicated
+      input — the armed device loss cap (spike-skip), the post-rollback
+      LR ease-in scale, and the ``nan:``/``spike:`` fault-injection seam;
+    - **post-window** (`on_step_end`): fetches the window's health fields
+      (ONE host sync per window — the guard's fence, same discipline as
+      obs=full), feeds the policy, writes quarantine records, escalates to
+      `Trainer._execute_guard_rollback` (via `_GuardRollback`) or
+      `DivergedError`, and runs the cross-replica SDC audit on cadence;
+    On an SDC finding, every save newer than the last clean audit is
+    quarantine-marked through `Trainer._quarantine_saves_after` and
+    further snapshots are suppressed until a regroup re-establishes a
+    trusted state (elastic/halt paths; ``sdc_action=warn`` records only).
+
+    Every rank computes the same policy decision from the same replicated
+    values — no coordination beyond the audit's existing allgather.
+    """
+
+    def __init__(self, trainer):
+        super().__init__(trainer)
+        import numpy as np  # noqa: F401  (validated lazily per call)
+
+        from tpu_dp.resilience.guard import GuardPolicy, QuarantineLog
+
+        cfg = trainer.cfg.guard
+        if cfg.sdc_action not in ("warn", "halt"):
+            raise ValueError(
+                f"guard.sdc_action must be warn|halt, got {cfg.sdc_action!r}"
+            )
+        self.policy = GuardPolicy(
+            action=cfg.action,
+            spike_window=cfg.spike_window,
+            spike_z=cfg.spike_z,
+            spike_min_steps=cfg.spike_min_steps,
+            device_cap=cfg.device_cap,
+            max_rollbacks=cfg.max_rollbacks,
+        )
+        self.log = QuarantineLog(trainer.quarantine_path)
+        self._checksum = None      # compiled params bit-checksum (lazy)
+        self._leaf_paths = None
+        self._sdc_marker = -1      # cadence-crossing marker (audit)
+        self._last_clean_audit = 0  # newest step a clean audit covered
+        self._ease_from: int | None = None  # LR ease-in anchor step
+
+    # -- pre-dispatch ---------------------------------------------------
+
+    def guard_in(self, first_step: int, n: int) -> dict:
+        """The sentinel input for the window [first_step, first_step+n)."""
+        import math
+
+        import numpy as np
+
+        from tpu_dp.train.step import default_guard_in
+
+        tr = self.tr
+        gi = default_guard_in()
+        cap = self.policy.loss_cap()
+        if math.isfinite(cap):
+            gi["loss_cap"] = np.float32(cap)
+        if self._ease_from is not None:
+            cfg = tr.cfg.guard
+            t = (first_step - self._ease_from) / max(1, cfg.lr_ease_steps)
+            if t >= 1.0:
+                self._ease_from = None
+            else:
+                scale = cfg.lr_ease_start + (1.0 - cfg.lr_ease_start) * max(
+                    0.0, t
+                )
+                gi["lr_scale"] = np.float32(scale)
+        if tr.fault is not None:
+            plan = tr.fault.device_fault()
+            if plan is not None:
+                gi["fault_step"] = np.int32(plan.step)
+                gi["fault_scale"] = np.float32(
+                    np.nan if plan.kind == "nan" else plan.scale
+                )
+        return gi
+
+    # -- rollback/regroup bookkeeping ----------------------------------
+
+    def arm_lr_ease(self, from_step: int) -> None:
+        if self.tr.cfg.guard.lr_ease_steps > 0:
+            self._ease_from = int(from_step)
+
+    def on_rollback_rewind(self, to_step: int) -> None:
+        """Re-arm the audit cadence below the old high-water step.
+
+        Same rewind contract as `SnapshotManager.rewind` and
+        `HeartbeatWriter.rewind`: without this, the crossing check would
+        compare against the pre-rollback marker and skip every audit for
+        the whole replay window — exactly the steps that just diverged.
+        """
+        self._sdc_marker = int(to_step)
+
+    def on_regroup(self) -> None:
+        """Topology changed (elastic shrink): the compiled checksum and the
+        cross-rank audit baseline are stale; policy statistics survive
+        (the loss scale did not change with the mesh)."""
+        self._checksum = None
+        self._leaf_paths = None
+        self._last_clean_audit = self.tr._host_step
+
+    # -- post-window ----------------------------------------------------
+
+    def on_step_end(self, ev: StepEvent) -> None:
+        import numpy as np
+
+        tr = self.tr
+        first = tr._host_step - ev.n + 1
+        # The guard's fence: one fetch of 3 scalars per window step. This
+        # is the only host sync guardrails add (measured by
+        # `bench.py --guard-overhead`).
+        records = []
+        for k, m in enumerate(ev.window):
+            records.append({
+                "step": first + k,
+                "loss": float(np.asarray(m["loss_raw"])),
+                "gnorm": float(np.asarray(m["grad_norm"])),
+                "applied": int(np.asarray(m["applied"])),
+            })
+        triggers = self.policy.observe(records)
+        escalate = None
+        for t in triggers:
+            self._record_trigger(ev, t, first)
+            if t.action in ("rollback", "halt"):
+                escalate = t
+        if escalate is not None:
+            self._escalate(ev, escalate)
+        cfg = tr.cfg.guard
+        # The audit pauses while a finding or a membership transition is
+        # in flight: re-gathering against a peer that is mid-eviction (or
+        # already exited 143) is a read-reset crash, and the post-regroup
+        # world re-baselines anyway (`on_regroup`).
+        quiescing = tr.elastic is not None and (
+            tr.elastic.quiescing or tr._quiesce_plan is not None
+        )
+        if cfg.sdc_every_steps > 0 and not tr._sdc_suspect_active \
+                and not quiescing:
+            prev = self._sdc_marker if self._sdc_marker >= 0 else 0
+            if tr._host_step // cfg.sdc_every_steps > prev // cfg.sdc_every_steps:
+                self._sdc_marker = tr._host_step
+                self._sdc_audit(ev)
+
+    def _record_trigger(self, ev: StepEvent, t, first: int) -> None:
+        tr = self.tr
+        _obs_counters.inc(f"guard.{t.kind}")
+        log0("guard: %s (action=%s)", t.reason, t.action)
+        if t.kind in ("nonfinite", "cap"):
+            _obs_counters.inc("guard.quarantined")
+        if tr.ctx.process_index != 0:  # dplint: allow(DP101) host-only IO
+            return
+        if t.kind in ("nonfinite", "cap"):
+            # The quarantined batch's sample-id range: the step's slice of
+            # the epoch's deterministic shuffle — (step-in-epoch) ×
+            # global-batch examples, re-identifiable from (seed, epoch).
+            pos = ev.done - ev.n + (t.step - first)
+            gbs = tr.global_batch_size
+            rec = self.log.quarantine(
+                epoch=ev.epoch, step=t.step,
+                sample_range=(pos * gbs, (pos + 1) * gbs),
+                rank=tr.ctx.process_index, reason=t.reason,
+            )
+            tr._log_metrics({"event": "guard_quarantine", "step": t.step,
+                             "reason": t.reason,
+                             "sample_range": rec["sample_range"]})
+        elif t.kind == "spike":
+            self.log.record("spike", step=t.step, field=t.field,
+                            value=t.value, z=t.z, action=t.action)
+            tr._log_metrics({"event": "guard_spike", "step": t.step,
+                             "field": t.field, "value": t.value, "z": t.z,
+                             "action": t.action})
+
+    def _escalate(self, ev: StepEvent, t) -> None:
+        from tpu_dp.resilience.guard import DivergedError
+        from tpu_dp.train.trainer import _GuardRollback
+
+        tr = self.tr
+        if t.action == "halt":
+            _obs_counters.inc("guard.halts")
+            raise DivergedError(f"guard halt: {t.reason}")
+        if tr.elastic is not None and tr.elastic.quiescing:
+            # A membership transition is converging; a local rewind now
+            # would desync this rank's step clock from the quiesce plan's.
+            # The trigger is recorded; the post-regroup replay re-detects
+            # anything real (interaction table, docs/RESILIENCE.md).
+            log0("guard: rollback deferred — elastic quiesce in flight")
+            return
+        raise _GuardRollback(ev.epoch, ev.done, t)
+
+    # -- SDC audit ------------------------------------------------------
+
+    def _sdc_audit(self, ev: StepEvent) -> None:
+        import numpy as np
+
+        from tpu_dp.parallel import dist
+        from tpu_dp.resilience.guard import (
+            DivergedError,
+            digest_of_sums,
+            leaf_paths,
+            make_params_checksum,
+            sdc_verdict,
+        )
+
+        tr = self.tr
+        if self._checksum is None:
+            self._checksum = make_params_checksum(tr.state.params)
+            self._leaf_paths = leaf_paths(tr.state.params)
+        sums = np.asarray(self._checksum(tr.state.params), dtype=np.uint32)
+        try:
+            gathered = dist.cross_rank_gather(sums)
+        except Exception:
+            if tr.elastic is not None:
+                # A peer died between the boundary check and the gather
+                # (e.g. an evicted rank's exit racing this audit): not an
+                # audit finding — the membership timeout/rollback path
+                # owns dead peers. Skip this audit; the regroup
+                # re-baselines.
+                log0("guard: SDC audit allgather failed — peer likely "
+                     "departed; deferring to the membership protocol",
+                     exc_info=True)
+                return
+            raise
+        verdict = sdc_verdict(gathered, self._leaf_paths)
+        _obs_counters.inc("guard.sdc_audits")
+        if verdict["consistent"]:
+            self._last_clean_audit = tr._host_step
+            return
+        _obs_counters.inc("guard.sdc_mismatches")
+        me = tr.ctx.process_index
+        digest = digest_of_sums(sums)
+        detail = {
+            "step": tr._host_step,
+            "suspects": verdict["suspects"],
+            "majority": verdict["majority"],
+            "leaves": {str(r): v[:8] for r, v in verdict["leaves"].items()},
+            "last_clean_step": self._last_clean_audit,
+            "digest": digest[:16],
+        }
+        log0("guard: SDC audit MISMATCH at step %d — suspect rank(s) %s "
+             "(divergent leaves: %s); params disagree bitwise across the "
+             "data axis", tr._host_step, verdict["suspects"],
+             detail["leaves"])
+        acting = tr.elastic is not None or tr.cfg.guard.sdc_action == "halt"
+        if me == 0:  # dplint: allow(DP101) host-only IO
+            self.log.record("sdc", **detail)
+            tr._log_metrics({"event": "guard_sdc", **detail})
+            if acting:
+                # Every save since the last clean audit may carry the
+                # corruption — mark them so no rollback/auto-resume
+                # trusts one. (warn mode records only: snapshots keep
+                # flowing and nothing on disk is condemned.)
+                tr._quarantine_saves_after(
+                    self._last_clean_audit,
+                    reason=f"sdc mismatch at step {tr._host_step} "
+                           f"(suspects {verdict['suspects']})",
+                )
+        if not acting:
+            # sdc_action=warn (diagnosis mode): record, keep snapshotting,
+            # keep auditing — a one-shot warning that permanently disabled
+            # durability and detection would be worse than no guard.
+            return
+        tr._sdc_suspect_active = True
+        if tr.elastic is not None:
+            # The existing regroup path evicts the corrupt replica: the
+            # suspect (who sees the same symmetric verdict) leaves with
+            # rollback flavor; everyone else publishes the accusation so
+            # the membership record attributes the eviction. The rollback
+            # resume skips the quarantined saves — survivors restart from
+            # the newest save that predates the suspicion.
+            if me in verdict["suspects"] or verdict["majority"] is None:
+                tr._guard_evict = True
+                log0("guard: this rank is the SDC suspect — leaving the "
+                     "membership (rollback regroup)")
+            else:
+                for r in verdict["suspects"]:
+                    tr.elastic.mark_suspect(
+                        r, f"sdc audit mismatch at step {tr._host_step}"
+                    )
+            return  # BoundaryHook (later this sweep) runs the transition
+        if tr.cfg.guard.sdc_action == "halt":
+            _obs_counters.inc("guard.halts")
+            raise DivergedError(
+                f"SDC audit mismatch at step {tr._host_step}: suspect "
+                f"rank(s) {verdict['suspects']} hold bitwise-divergent "
+                f"params (divergent leaves: {detail['leaves']}); halting "
+                f"before the corruption reaches another snapshot"
+            )
+
+    def close(self) -> None:
+        self.log.close()

@@ -153,10 +153,20 @@ def _forward_backward(model, loss_impl, state: TrainState, images, labels,
 
 def _apply_update(
     optimizer: Optimizer, schedule: Schedule, state: TrainState, grads,
-    new_batch_stats,
+    new_batch_stats, lr_scale=None,
 ):
-    """Shared optimizer tail: LR lookup, update, next TrainState."""
+    """Shared optimizer tail: LR lookup, update, next TrainState.
+
+    ``lr_scale`` is the guardrail layer's LR ease-in knob (a replicated
+    runtime scalar from ``guard_in``): after a rollback the policy ramps it
+    from ``guard.lr_ease_start`` back to 1.0 so the replayed window does not
+    re-trace the exact trajectory that diverged. None (the default, every
+    non-sentinel program) leaves the schedule untouched — and the trace
+    unchanged.
+    """
     lr = schedule(state.step)
+    if lr_scale is not None:
+        lr = lr * lr_scale
     new_params, new_opt_state = optimizer.update(
         grads, state.opt_state, state.params, lr
     )
@@ -178,8 +188,152 @@ def _select_loss_impl(use_pallas_xent: bool):
     return cross_entropy_loss
 
 
+def default_guard_in():
+    """The neutral ``guard_in`` pytree the sentinel-enabled steps take.
+
+    A replicated input of four scalars (host-built numpy so constructing it
+    never touches a device):
+
+    - ``loss_cap`` — device-side skip threshold: a finite training loss
+      above it is treated like a non-finite one (update not applied). The
+      guard policy arms it from the trailing window's median/MAD under
+      ``guard.action=skip``; +inf disarms.
+    - ``lr_scale`` — multiplies the scheduled LR (rollback ease-in; 1.0 is
+      exact identity, bitwise).
+    - ``fault_step`` / ``fault_scale`` — the deterministic fault-injection
+      seam (``TPU_DP_FAULT`` ``nan:``/``spike:`` specs, docs/RESILIENCE.md):
+      at ``state.step == fault_step`` the loss and gradients are multiplied
+      by ``fault_scale`` *inside the compiled program* (NaN for ``nan:``,
+      a large finite scale for ``spike:``). ``fault_step=-1`` never fires,
+      and the disarmed multiply-by-1.0 is bitwise identity.
+
+    Feeding the same dtypes every call keeps the trace signature stable
+    (one cache entry; the RecompileGuard stays silent).
+    """
+    import numpy as np
+
+    return {
+        "loss_cap": np.float32(np.inf),
+        "lr_scale": np.float32(1.0),
+        "fault_step": np.int32(-1),
+        "fault_scale": np.float32(1.0),
+    }
+
+
+def guard_in_struct():
+    """ShapeDtypeStruct twin of `default_guard_in` (AOT fingerprinting)."""
+    return {
+        "loss_cap": jax.ShapeDtypeStruct((), jnp.float32),
+        "lr_scale": jax.ShapeDtypeStruct((), jnp.float32),
+        "fault_step": jax.ShapeDtypeStruct((), jnp.int32),
+        "fault_scale": jax.ShapeDtypeStruct((), jnp.float32),
+    }
+
+
+def _inject_guard_fault(step, loss, grads, guard_in):
+    """The ``nan:``/``spike:`` injection seam, compiled into the step.
+
+    Sits on the *pre-reduction* gradients so a rank-gated fault propagates
+    to every replica through the gradient collective exactly like a real
+    corrupted batch would (explicit-collectives paths; under GSPMD the
+    partitioner may place the multiply after the inferred all-reduce, so
+    rank-gated injection there stays rank-local — documented in
+    docs/RESILIENCE.md). Disarmed (``fault_step=-1``) this is a
+    multiply-by-1.0: bitwise identity.
+    """
+    fire = step == guard_in["fault_step"]
+    factor = jnp.where(fire, guard_in["fault_scale"], jnp.float32(1.0))
+    loss = loss * factor.astype(loss.dtype)
+    grads = jax.tree_util.tree_map(
+        lambda g: g * factor.astype(g.dtype), grads
+    )
+    return loss, grads
+
+
+def _grad_health(grads, loss, health_reduce=None):
+    """The on-device health summary: global grad-norm + finite-ness flag.
+
+    ``sum(g²)`` in f32 over every leaf; a single NaN/Inf anywhere in the
+    gradient tree makes the sum non-finite, so one scalar carries both the
+    norm and the finite-ness signal. ``health_reduce`` closes the
+    cross-replica gap on the sharded-update path (each replica holds a
+    1/world gradient shard, so the local sum-of-squares is partial — one
+    extra *scalar* psum over the data axis, the only collective the
+    sentinel ever adds; replicated/GSPMD paths compute on already-reduced
+    gradients and add none).
+    """
+    sumsq = jnp.zeros((), jnp.float32)
+    for g in jax.tree_util.tree_leaves(grads):
+        sumsq = sumsq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+    if health_reduce is not None:
+        sumsq = health_reduce(sumsq)
+    finite = jnp.isfinite(loss.astype(jnp.float32)) & jnp.isfinite(sumsq)
+    return jnp.sqrt(sumsq), finite
+
+
+def _sentinel_tail(optimizer, schedule, state, grads, new_batch_stats,
+                   loss, correct, count, guard_in, health_reduce,
+                   opt_pred_cast=None):
+    """The sentinel step tail: health summary → guarded update → metrics.
+
+    The update is computed unconditionally and then *selected against*: a
+    step whose loss/gradients are non-finite, or whose loss exceeds the
+    armed ``loss_cap``, emits the ORIGINAL state — params, optimizer
+    state, BatchNorm statistics and the step counter all unchanged, as if
+    the batch was never seen (the quarantine contract: the final params of
+    a run that skipped batch K are bitwise those of a run that never saw
+    it). The decision is computed from globally-reduced values, so every
+    replica selects identically — no divergence, no extra sync.
+
+    Metrics grow the health fields the guard policy consumes (one host
+    fetch per window, at the existing fence boundary): ``loss_raw`` /
+    ``grad_norm`` (unmasked), ``applied`` (0 = quarantined). ``loss`` and
+    ``correct`` are masked to zero on skipped steps so the epoch
+    accumulators never ingest a NaN.
+    """
+    if guard_in is None:
+        guard_in = default_guard_in()
+    with jax.named_scope("tpu_dp.sentinel"):
+        gnorm, finite = _grad_health(grads, loss, health_reduce)
+        applied = finite & (loss.astype(jnp.float32) <= guard_in["loss_cap"])
+    with jax.named_scope("tpu_dp.update"):
+        new_state, lr = _apply_update(
+            optimizer, schedule, state, grads, new_batch_stats,
+            lr_scale=guard_in["lr_scale"],
+        )
+        # ``opt_pred_cast`` (sharded update only): the opt-state leaves
+        # are device-varying 1/world shards under shard_map's replication
+        # typing, so the invariant skip predicate is cast varying for that
+        # subtree (`_to_varying`; a no-op on pre-vma JAX and everywhere
+        # else the whole state is replicated).
+        opt_pred = applied if opt_pred_cast is None else opt_pred_cast(applied)
+        new_state = TrainState(
+            step=jnp.where(applied, new_state.step, state.step),
+            params=jax.tree_util.tree_map(
+                lambda n, o: jnp.where(applied, n, o),
+                new_state.params, state.params),
+            opt_state=jax.tree_util.tree_map(
+                lambda n, o: jnp.where(opt_pred, n, o),
+                new_state.opt_state, state.opt_state),
+            batch_stats=jax.tree_util.tree_map(
+                lambda n, o: jnp.where(applied, n, o),
+                new_state.batch_stats, state.batch_stats),
+        )
+    metrics = {
+        "loss": jnp.where(applied, loss, jnp.zeros_like(loss)),
+        "correct": jnp.where(applied, correct, jnp.zeros_like(correct)),
+        "count": count,
+        "lr": lr,
+        "loss_raw": loss,
+        "grad_norm": gnorm,
+        "applied": applied.astype(jnp.int32),
+    }
+    return new_state, metrics
+
+
 def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn,
-                    reduce_fn=None, cast_params=None):
+                    reduce_fn=None, cast_params=None, sentinel=False,
+                    health_reduce=None, opt_pred_cast=None):
     """The single-microbatch step body shared by `make_train_step`
     (accum_steps=1) and `make_multi_step`'s scan — one source of truth for
     normalize → augment → fwd/bwd → [cross-replica reduce] → update →
@@ -190,9 +344,15 @@ def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn,
     infers the gradient all-reduce from shardings), the `shard_map` path
     injects the typed collective wrappers between the per-shard grads and
     the optimizer update — the one placement `tpu_dp.analysis` verifies.
+
+    ``sentinel=True`` (the guardrail layer, docs/RESILIENCE.md
+    "Guardrails") adds the on-device health summary + guarded update
+    (`_sentinel_tail`) and the ``guard_in`` third argument; off (the
+    default) the body — and its compiled HLO — is bit-for-bit the program
+    it always was.
     """
 
-    def body(state: TrainState, batch):
+    def body(state: TrainState, batch, guard_in=None):
         # jax.named_scope: names land in HLO op metadata, so device-side
         # profiles (jax.profiler XPlane / Perfetto) attribute time to the
         # training phase instead of to anonymous fusions. Metadata only —
@@ -210,11 +370,20 @@ def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn,
                 cast_params=cast_params
             )
         count = jnp.asarray(labels.shape[0], jnp.int32)
+        if sentinel:
+            gi = guard_in if guard_in is not None else default_guard_in()
+            loss, grads = _inject_guard_fault(state.step, loss, grads, gi)
         if reduce_fn is not None:
             with jax.named_scope("tpu_dp.grad_reduce"):
                 grads, loss, correct, count, new_batch_stats = reduce_fn(
                     grads, loss, correct, count, new_batch_stats
                 )
+        if sentinel:
+            return _sentinel_tail(
+                optimizer, schedule, state, grads, new_batch_stats,
+                loss, correct, count, guard_in, health_reduce,
+                opt_pred_cast=opt_pred_cast,
+            )
         with jax.named_scope("tpu_dp.update"):
             new_state, lr = _apply_update(
                 optimizer, schedule, state, grads, new_batch_stats
@@ -232,7 +401,8 @@ def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn,
 
 def _make_accum_body(
     model, optimizer, schedule, loss_impl, augment_fn, accum_steps,
-    reduce_fn=None, cast_params=None,
+    reduce_fn=None, cast_params=None, sentinel=False, health_reduce=None,
+    opt_pred_cast=None,
 ):
     """The gradient-accumulation step body: one optimizer update from
     ``accum_steps`` sequential microbatches.
@@ -246,7 +416,7 @@ def _make_accum_body(
     program), so the two paths cannot drift apart.
     """
 
-    def body(state: TrainState, batch):
+    def body(state: TrainState, batch, guard_in=None):
         # Same named_scope annotations as `_make_step_body` (HLO metadata
         # for device-side trace attribution; schedule-neutral).
         with jax.named_scope("tpu_dp.input"):
@@ -288,6 +458,13 @@ def _make_accum_body(
         loss = loss_sum / accum_steps
         count = jnp.asarray(labels.shape[0] * labels.shape[1], jnp.int32)
 
+        # The fault seam sits on the accumulated (whole-update) gradients,
+        # like the reduce hook: one injected fault means one poisoned
+        # optimizer update, never a per-microbatch spray.
+        if sentinel:
+            gi = guard_in if guard_in is not None else default_guard_in()
+            loss, grads = _inject_guard_fault(state.step, loss, grads, gi)
+
         # The reduce hook sits AFTER the microbatch scan and the 1/accum
         # rescale: exactly one cross-replica reduction per optimizer update,
         # never one per microbatch (`tpu_dp.analysis` DP202 verifies this).
@@ -297,6 +474,12 @@ def _make_accum_body(
                     grads, loss, correct, count, new_batch_stats
                 )
 
+        if sentinel:
+            return _sentinel_tail(
+                optimizer, schedule, state, grads, new_batch_stats,
+                loss, correct, count, guard_in, health_reduce,
+                opt_pred_cast=opt_pred_cast,
+            )
         with jax.named_scope("tpu_dp.update"):
             new_state, lr = _apply_update(
                 optimizer, schedule, state, grads, new_batch_stats
@@ -313,7 +496,8 @@ def _make_accum_body(
 
 
 def _select_body(model, optimizer, schedule, loss_impl, augment_fn,
-                 accum_steps, reduce_fn=None, cast_params=None):
+                 accum_steps, reduce_fn=None, cast_params=None,
+                 sentinel=False, health_reduce=None, opt_pred_cast=None):
     """One source of truth for the per-update body: plain step at
     accum_steps == 1, gradient-accumulation body otherwise. Used by
     `make_train_step`, `make_multi_step`, and (via `make_local_step`) the
@@ -322,10 +506,14 @@ def _select_body(model, optimizer, schedule, loss_impl, augment_fn,
     if accum_steps == 1:
         return _make_step_body(model, optimizer, schedule, loss_impl,
                                augment_fn, reduce_fn=reduce_fn,
-                               cast_params=cast_params)
+                               cast_params=cast_params, sentinel=sentinel,
+                               health_reduce=health_reduce,
+                               opt_pred_cast=opt_pred_cast)
     return _make_accum_body(model, optimizer, schedule, loss_impl,
                             augment_fn, accum_steps, reduce_fn=reduce_fn,
-                            cast_params=cast_params)
+                            cast_params=cast_params, sentinel=sentinel,
+                            health_reduce=health_reduce,
+                            opt_pred_cast=opt_pred_cast)
 
 
 def make_train_step(
@@ -336,6 +524,7 @@ def make_train_step(
     use_pallas_xent: bool = False,
     accum_steps: int = 1,
     augment_fn: Callable | None = None,
+    sentinel: bool = False,
 ) -> Callable:
     """Build the jitted DP train step for this model/optimizer/mesh.
 
@@ -345,6 +534,14 @@ def make_train_step(
     and example count — the per-step statistics the reference prints
     (`cifar_example.py:83-87`) plus what its synced eval metric accumulates
     (`cifar_example_ddp.py:133`).
+
+    ``sentinel=True`` (guard.enabled, docs/RESILIENCE.md "Guardrails")
+    compiles the on-device health summary + guarded update into the
+    program: the signature becomes ``step(state, batch, guard_in)``
+    (`default_guard_in` — replicated scalars, not donated) and metrics
+    gain ``loss_raw`` / ``grad_norm`` / ``applied``. Off, the factory —
+    and the compiled HLO — is exactly the pre-guardrails program (the
+    DP304 fingerprint is digest-identical).
     """
     # The GSPMD path is replicated-update only (the sharded update needs
     # explicit collectives — `make_train_step_shard_map`); reject a
@@ -358,11 +555,12 @@ def make_train_step(
     # the optional weight mask) shards on its leading dim — or, with
     # accumulation, on the microbatch dim after the scan axis.
     step = _select_body(model, optimizer, schedule, loss_impl, augment_fn,
-                        accum_steps)
+                        accum_steps, sentinel=sentinel)
     in_batch_sh = batch_sh if accum_steps == 1 else scan_batch_sharding(mesh)
+    in_sh = (repl, in_batch_sh) + ((repl,) if sentinel else ())
     return jax.jit(
         step,
-        in_shardings=(repl, in_batch_sh),
+        in_shardings=in_sh,
         out_shardings=(repl, repl),
         donate_argnums=(0,),
     )
@@ -379,6 +577,7 @@ def make_multi_step(
     accum_steps: int = 1,
     update_sharding: str = "replicated",
     collective_dtype: str | None = None,
+    sentinel: bool = False,
 ) -> Callable:
     """Device-side training loop: ``num_steps`` train steps in ONE program.
 
@@ -410,6 +609,14 @@ def make_multi_step(
     1/world update → params all-gather inside every scanned step, opt state
     permanently sharded over ``data``); ``optimizer`` must then be a
     `train.optim.ShardedUpdate`, as for `make_train_step_shard_map`.
+
+    ``sentinel=True`` scans the sentinel body: the loop signature becomes
+    ``loop(state, batches, guard_in)`` with ONE replicated ``guard_in``
+    shared by every step of the window (the policy's cap/ease values are
+    per-window by construction — the host only observes window
+    boundaries). A window step that trips the guard emits the unchanged
+    carry, so the remaining scanned steps continue from the pre-fault
+    state exactly like the per-step path.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -425,16 +632,20 @@ def make_multi_step(
             world=data_axis_size(mesh), axis_name=DATA_AXIS,
             update_sharding=update_sharding,
             collective_dtype=collective_dtype,
+            sentinel=sentinel,
         )
     else:
         _check_update_sharding(update_sharding, optimizer)
         body = _select_body(model, optimizer, schedule, loss_impl,
-                            augment_fn, accum_steps)
+                            augment_fn, accum_steps, sentinel=sentinel)
 
-    def loop(state: TrainState, batches):
+    def loop(state: TrainState, batches, guard_in=None):
+        step_body = body if guard_in is None else (
+            lambda st, mb: body(st, mb, guard_in)
+        )
         pool = jax.tree_util.tree_leaves(batches)[0].shape[0]
         if pool == num_steps:
-            return jax.lax.scan(body, state, batches, length=num_steps)
+            return jax.lax.scan(step_body, state, batches, length=num_steps)
 
         def indexed_body(st, i):
             mb = jax.tree_util.tree_map(
@@ -443,7 +654,7 @@ def make_multi_step(
                 ),
                 batches,
             )
-            return body(st, mb)
+            return step_body(st, mb)
 
         return jax.lax.scan(
             indexed_body, state, jnp.arange(num_steps, dtype=jnp.int32)
@@ -463,12 +674,13 @@ def make_multi_step(
         run = _shard_map(
             loop,
             mesh=mesh,
-            in_specs=(_state_specs(update_sharding), batch_spec),
+            in_specs=(_state_specs(update_sharding), batch_spec)
+            + ((P(),) if sentinel else ()),
             out_specs=(_state_specs(update_sharding), P()),
         )
     return jax.jit(
         run,
-        in_shardings=(state_sh, in_batch_sh),
+        in_shardings=(state_sh, in_batch_sh) + ((repl,) if sentinel else ()),
         out_shardings=(state_sh, repl),
         donate_argnums=(0,),
     )
@@ -485,6 +697,7 @@ def make_multi_step_resident(
     accum_steps: int = 1,
     update_sharding: str = "replicated",
     collective_dtype: str | None = None,
+    sentinel: bool = False,
 ) -> Callable:
     """Windowed training loop fed by a device-resident dataset + indices.
 
@@ -524,16 +737,21 @@ def make_multi_step_resident(
             world=data_axis_size(mesh), axis_name=DATA_AXIS,
             update_sharding=update_sharding,
             collective_dtype=collective_dtype,
+            sentinel=sentinel,
         )
     else:
         _check_update_sharding(update_sharding, optimizer)
         body = _select_body(model, optimizer, schedule, loss_impl,
-                            augment_fn, accum_steps)
+                            augment_fn, accum_steps, sentinel=sentinel)
 
-    def loop(state: TrainState, data, idx):
+    def loop(state: TrainState, data, idx, guard_in=None):
+        step_body = body if guard_in is None else (
+            lambda st, mb: body(st, mb, guard_in)
+        )
+
         def indexed_body(st, idx_step):
             mb = jax.tree_util.tree_map(lambda x: x[idx_step], data)
-            return body(st, mb)
+            return step_body(st, mb)
 
         # length pins the window size: a mis-shaped idx errors at trace
         # time instead of silently running a different number of steps.
@@ -548,12 +766,13 @@ def make_multi_step_resident(
         run = _shard_map(
             loop,
             mesh=mesh,
-            in_specs=(_state_specs(update_sharding), P(), idx_spec),
+            in_specs=(_state_specs(update_sharding), P(), idx_spec)
+            + ((P(),) if sentinel else ()),
             out_specs=(_state_specs(update_sharding), P()),
         )
     return jax.jit(
         run,
-        in_shardings=(state_sh, repl, idx_sh),
+        in_shardings=(state_sh, repl, idx_sh) + ((repl,) if sentinel else ()),
         out_shardings=(state_sh, repl),
         donate_argnums=(0,),
     )
@@ -654,6 +873,7 @@ def make_local_step(
     cast_params: bool = True,
     update_sharding: str = "replicated",
     collective_dtype: str | None = None,
+    sentinel: bool = False,
 ) -> Callable:
     """The per-shard step program with *explicit* collectives, unjitted.
 
@@ -737,8 +957,22 @@ def make_local_step(
     # *varying* keeps AD local: per-shard grads out, exactly what DDP's
     # reducer sees pre-allreduce.
     cast = (lambda p: _to_varying(p, axis_name)) if cast_params else None
+    # The sentinel's cross-replica gap on the sharded path: each replica
+    # holds a 1/world gradient shard, so the health sum-of-squares needs
+    # one scalar psum (the ONLY collective the sentinel adds — the
+    # replicated path computes it on already-pmean'ed grads), and the
+    # skip select over the varying opt-state shards needs a varying
+    # predicate under replication typing.
+    health_reduce = None
+    opt_pred_cast = None
+    if sentinel and update_sharding == "sharded":
+        health_reduce = lambda s: collectives.psum(s, axis_name)  # noqa: E731
+        if cast_params:
+            opt_pred_cast = lambda p: _to_varying(p, axis_name)  # noqa: E731
     return _select_body(model, optimizer, schedule, loss_impl, augment_fn,
-                        accum_steps, reduce_fn=reduce_fn, cast_params=cast)
+                        accum_steps, reduce_fn=reduce_fn, cast_params=cast,
+                        sentinel=sentinel, health_reduce=health_reduce,
+                        opt_pred_cast=opt_pred_cast)
 
 
 def make_train_step_shard_map(
@@ -751,6 +985,7 @@ def make_train_step_shard_map(
     augment_fn: Callable | None = None,
     update_sharding: str = "replicated",
     collective_dtype: str | None = None,
+    sentinel: bool = False,
 ) -> Callable:
     """Explicit-collectives variant of the DP train step (`shard_map`).
 
@@ -799,6 +1034,7 @@ def make_train_step_shard_map(
         accum_steps=accum_steps, augment_fn=augment_fn,
         world=data_axis_size(mesh), axis_name=DATA_AXIS,
         update_sharding=update_sharding, collective_dtype=collective_dtype,
+        sentinel=sentinel,
     )
 
     # Replication checking stays ON: an output that is rank-varying (a
@@ -807,12 +1043,12 @@ def make_train_step_shard_map(
     sharded = _shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(state_spec, batch_spec),
+        in_specs=(state_spec, batch_spec) + ((repl_spec,) if sentinel else ()),
         out_specs=(state_spec, repl_spec),
     )
     return jax.jit(
         sharded,
-        in_shardings=(state_sh, batch_sh),
+        in_shardings=(state_sh, batch_sh) + ((repl,) if sentinel else ()),
         out_shardings=(state_sh, repl),
         donate_argnums=(0,),
     )

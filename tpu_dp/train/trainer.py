@@ -70,6 +70,22 @@ class _RegroupSignal(Exception):
         self.plan = plan
 
 
+class _GuardRollback(Exception):
+    """Raised out of `train_epoch` by the guard hook when the divergence
+    policy escalates to rollback: rewind to the newest complete (and
+    non-quarantined) save before the next step
+    (`Trainer._execute_guard_rollback`). Internal control flow — never
+    escapes `fit()`."""
+
+    def __init__(self, epoch: int, done: int, trigger):
+        super().__init__(
+            f"guard rollback at epoch {epoch} step {done}: {trigger.reason}"
+        )
+        self.epoch = int(epoch)
+        self.done = int(done)
+        self.trigger = trigger
+
+
 def _elastic_fatal_errors() -> tuple[type[BaseException], ...]:
     """Exception types that mean "a peer is gone" in elastic mode:
     a wedged/failed collective (XLA runtime) or an exhausted resilient
@@ -218,6 +234,11 @@ class Trainer:
                 "resilience.elastic requires data.drop_remainder=true "
                 "(the mid-epoch re-split carries no weight masks)"
             )
+        # Training guardrails (tpu_dp/resilience/guard.py,
+        # docs/RESILIENCE.md "Guardrails"): guard.enabled compiles the
+        # sentinel into every train-step program (on-device health summary
+        # + guarded update) and registers the GuardHook policy engine.
+        self.guard_enabled = bool(cfg.guard.enabled)
 
         # Everything world-dependent — pipelines, optimizer layout,
         # compiled programs, resident staging — is built by the two
@@ -276,6 +297,17 @@ class Trainer:
         self.fault = FaultInjector.from_spec(
             res.fault, rank=self.ctx.process_index
         )
+        if self.fault is not None and not self.guard_enabled and (
+            self.fault.plan.kind in ("nan", "spike")
+        ):
+            # The nan/spike injection seam is compiled into the sentinel
+            # step; without the sentinel the fault would silently never
+            # fire — the worst property a deterministic injector can have.
+            raise ValueError(
+                f"TPU_DP_FAULT {self.fault.plan.kind!r} requires "
+                f"guard.enabled=true (the injection seam lives in the "
+                f"sentinel-enabled step program)"
+            )
         # Elastic world size (tpu_dp/resilience/elastic.py): this rank's
         # stable id is its process index at generation start; dense ranks
         # are reassigned per membership epoch, sids never. The epoch's
@@ -366,6 +398,21 @@ class Trainer:
             self._step_profiler = StepProfiler(
                 cfg.train.profile_dir, *profile_range
             )
+
+        # Guardrail run state: the rollback generation stamps every
+        # metrics/quarantine record written after a rewind (post-hoc
+        # tooling must never double-count replayed steps), and the evict
+        # flag is the SDC audit's "this rank is corrupt — leave" handoff
+        # to the elastic boundary.
+        self._rollback_gen = 0
+        self._guard_evict = False
+        self._sdc_suspect_active = False  # suppresses snapshots (hooks.py)
+
+        # The step-lifecycle hook registry (tpu_dp/train/hooks.py): every
+        # cross-cutting subsystem — guardrails, snapshots, fault injection,
+        # heartbeats, profiling, the elastic/preemption boundary —
+        # registers here instead of splicing into the hot loop.
+        self._build_hooks()
 
         if cfg.train.verify_fingerprint:
             self._verify_step_fingerprint()
@@ -458,6 +505,7 @@ class Trainer:
                     augment_fn=augment_fn,
                     update_sharding=us,
                     collective_dtype=cfg.train.collective_dtype or None,
+                    sentinel=self.guard_enabled,
                 ))
         else:
             self.train_step = self._guarded("train_step", make_train_step(
@@ -465,6 +513,7 @@ class Trainer:
                 use_pallas_xent=cfg.train.pallas_xent,
                 accum_steps=cfg.optim.grad_accum_steps,
                 augment_fn=augment_fn,
+                sentinel=self.guard_enabled,
             ))
         self.eval_step = make_eval_step(self.model, self.mesh,
                                         update_sharding=us)
@@ -493,6 +542,7 @@ class Trainer:
                 accum_steps=cfg.optim.grad_accum_steps,
                 update_sharding=us,
                 collective_dtype=cfg.train.collective_dtype or None,
+                sentinel=self.guard_enabled,
             ))
 
         # Device-resident feed (VERDICT r4 next-steps #3): stage the train
@@ -510,6 +560,134 @@ class Trainer:
             and cfg.data.drop_remainder
             and self.train_pipe.dataset_bytes() <= cfg.data.resident_max_bytes
         )
+
+    def _build_hooks(self) -> None:
+        """Register the step-lifecycle hooks, in load-bearing order.
+
+        Guard first (a triggering window must not be snapshotted before
+        its rollback picks a target), snapshot cadence, fault injection
+        (a kill at step K lands after the step-K snapshot — the
+        kill/resume contract), heartbeats (injected delays attribute to
+        the step they fired at), profiling, and the elastic/preemption
+        boundary last (it raises on a transition). Hooks whose subsystem
+        is off no-op per call, so the registry survives a regroup's
+        observer rebuild without being rebuilt itself.
+        """
+        from tpu_dp.train.hooks import (
+            BoundaryHook,
+            FaultHook,
+            GuardHook,
+            HeartbeatHook,
+            ProfilerHook,
+            SnapshotHook,
+        )
+
+        self._guard_hook = GuardHook(self) if self.guard_enabled else None
+        hooks: list = []
+        if self._guard_hook is not None:
+            hooks.append(self._guard_hook)
+        hooks += [SnapshotHook(self), FaultHook(self), HeartbeatHook(self),
+                  ProfilerHook(self), BoundaryHook(self)]
+        self._hooks = hooks
+
+    @property
+    def quarantine_path(self) -> Path:
+        """The quarantine.jsonl sink (guard.quarantine_path, defaulting to
+        <ckpt_dir>/quarantine.jsonl; the --guard CI lane archives it)."""
+        return Path(
+            self.cfg.guard.quarantine_path
+            or Path(self.cfg.train.ckpt_dir) / "quarantine.jsonl"
+        )
+
+    def _take_snapshot(self, epoch: int, steps_done: int,
+                       wait: bool = False) -> None:
+        """One snapshot + the ``on_snapshot`` hook sweep (cadence,
+        preemption final, and elastic quiesce final all route here so
+        every registered hook sees every committed snapshot)."""
+        meta = self._snapshot_meta(epoch, steps_done)
+        self.snap_mgr.snapshot(self.state, self._host_step, meta)
+        if wait:
+            self.snap_mgr.wait()
+        for hook in self._hooks:
+            hook.on_snapshot(epoch, steps_done, self._host_step, meta)
+
+    def _inject_sdc(self, plan) -> None:
+        """Apply an ``sdc:`` fault: flip one HIGH bit of the matching
+        params leaves on THIS rank's replica (testing only).
+
+        The honest simulation of silent data corruption: the local copy of
+        a logically-replicated parameter silently diverges — no error, no
+        NaN, just a replica whose forward pass (and gradient contribution)
+        is wrong from here on. The flipped bit is the top exponent bit
+        (bit 30 for f32), not a low mantissa bit: a low-bit flip of a
+        zero-initialized leaf makes a denormal the very next (identical
+        across replicas) update arithmetically absorbs, leaving nothing
+        for the audit to catch — whereas the cross-replica delta of a
+        high-bit flip survives identical additive updates exactly.
+        ``leaf=`` globs over the "/"-joined leaf paths; default corrupts
+        the first leaf.
+        """
+        import fnmatch
+
+        from tpu_dp.resilience.guard import leaf_paths
+
+        paths = leaf_paths(self.state.params)
+        targets = (
+            [p for p in paths if fnmatch.fnmatch(p, plan.leaf)]
+            if plan.leaf else paths[:1]
+        )
+        if not targets:
+            raise ValueError(
+                f"sdc fault leaf={plan.leaf!r} matches no params leaf; "
+                f"available: {paths[:8]}..."
+            )
+        log0("fault injection: sdc bit-flip on rank %d at step %d "
+             "(leaves %s)", self.ctx.process_index, self._host_step, targets)
+        flat, treedef = jax.tree_util.tree_flatten(self.state.params)
+        new_flat = []
+        for path, leaf in zip(paths, flat):
+            if path in targets:
+                host = np.asarray(leaf).copy()
+                width = host.dtype.itemsize
+                view = host.reshape(-1).view(
+                    {1: np.uint8, 2: np.uint16, 4: np.uint32,
+                     8: np.uint64}[width]
+                )
+                view[0] ^= np.asarray(1 << (8 * width - 2), view.dtype)
+                # STRICTLY process-local rebuild: place the mutated host
+                # copy onto each addressable device and reassemble the
+                # logical array from the single-device pieces. A plain
+                # `device_put(host, global_sharding)` can dispatch mesh
+                # work the OTHER ranks never dispatch, desyncing the
+                # collective stream — the injected "corruption" would then
+                # crash the job instead of silently poisoning it, which is
+                # the opposite of what SDC does.
+                pieces = [
+                    jax.device_put(host[s.index], s.device)
+                    for s in leaf.addressable_shards
+                ]
+                leaf = jax.make_array_from_single_device_arrays(
+                    leaf.shape, leaf.sharding, pieces
+                )
+            new_flat.append(leaf)
+        self.state = self.state.replace(
+            params=jax.tree_util.tree_unflatten(treedef, new_flat)
+        )
+
+    def _quarantine_saves_after(self, clean_step: int, reason: str) -> None:
+        """Mark every complete save newer than ``clean_step`` untrusted
+        (rank 0 — the save writer — only; `find_candidates` then skips
+        them, so no rollback or ``--resume=auto`` lands on a save that may
+        carry the corruption)."""
+        from tpu_dp.resilience import find_candidates, quarantine_save_dir
+
+        for source, step in find_candidates(
+            self.cfg.train.ckpt_dir, self.snapshot_dir
+        ):
+            if step > int(clean_step):
+                quarantine_save_dir(source, reason)
+                log0("guard: quarantined save %s (step %d > last clean "
+                     "audit %d)", source, step, clean_step)
 
     def _verify_step_fingerprint(self, tag: str = "train_step") -> None:
         """Cross-rank collective-schedule check at startup (dplint DP304).
@@ -534,7 +712,12 @@ class Trainer:
             ),
             "label": jax.ShapeDtypeStruct(prefix + (gb,), jnp.int32),
         }
-        digest = program_fingerprint(self.train_step, (self.state, batch))
+        args = (self.state, batch)
+        if self.guard_enabled:
+            from tpu_dp.train.step import guard_in_struct
+
+            args = args + (guard_in_struct(),)
+        digest = program_fingerprint(self.train_step, args)
         dist.verify_collective_fingerprint(digest, tag=tag)
         log0("collective-schedule fingerprint (%s): %s", tag, digest[:16])
 
@@ -787,6 +970,7 @@ class Trainer:
                 accum_steps=self.cfg.optim.grad_accum_steps,
                 update_sharding=self.update_sharding,
                 collective_dtype=self.cfg.train.collective_dtype or None,
+                sentinel=self.guard_enabled,
             ))
             self._resident_loops[n] = loop
         return loop
@@ -839,8 +1023,10 @@ class Trainer:
         # obs mode that adds a host sync, which is why it is opt-in).
         spans = self.spans
         obs_full = self.obs_mode == "full"
-        t_boundary = time.perf_counter()  # heartbeat boundary-to-boundary clock
-        hb_steps = 0  # steps since the last accepted heartbeat
+        from tpu_dp.train.hooks import StepEvent
+
+        for hook in self._hooks:
+            hook.on_epoch_start(epoch)
         it = iter(items)
         while True:
             if spans is not None:
@@ -854,12 +1040,15 @@ class Trainer:
                 n, item = next(it)
             except StopIteration:
                 break
-            if self._step_profiler is not None:
-                # BEFORE dispatch: the window about to run is steps
-                # [_host_step + 1, _host_step + n] — arming at the
-                # post-window boundary would trace the window after the
-                # requested range (and miss in-window ranges entirely).
-                self._step_profiler.on_window_start(self._host_step + 1, n)
+            for hook in self._hooks:
+                hook.on_window_start(self._host_step + 1, n)
+            # The sentinel's replicated input (guard on only): armed loss
+            # cap, LR ease-in scale, and the nan/spike injection seam.
+            guard_args = ()
+            if self._guard_hook is not None:
+                guard_args = (
+                    self._guard_hook.guard_in(self._host_step + 1, n),
+                )
             if spans is not None:
                 t1 = time.perf_counter()
                 t2 = t1
@@ -870,15 +1059,17 @@ class Trainer:
                 # Indices in, stacked metrics out — the dataset never
                 # re-crosses the host→device link.
                 self.state, stacked = self._resident_loop(n)(
-                    self.state, self.resident_train, item
+                    self.state, self.resident_train, item, *guard_args
                 )
                 window = _unstack(stacked, n)
             elif n == 1:
-                self.state, m = self.train_step(self.state, item)
+                self.state, m = self.train_step(self.state, item,
+                                                *guard_args)
                 window = (m,)
             else:
                 # One dispatch, n optimizer steps (device-side scanned loop).
-                self.state, stacked = self.multi_step(self.state, item)
+                self.state, stacked = self.multi_step(self.state, item,
+                                                      *guard_args)
                 window = _unstack(stacked, n)
             if spans is not None:
                 t3 = time.perf_counter()
@@ -947,55 +1138,19 @@ class Trainer:
                         # still up, not in the postmortem.
                         issues = self.health.report(self.health.check())
                         self._suspect_from_health(issues)
-            # Resilience hooks, once per dispatched window (the host-side
-            # step boundary): async snapshot on cadence, then fault
-            # injection (tests), then the preemption/elastic flag check.
+            # The step-lifecycle hook sweep, once per dispatched window
+            # (the host-side step boundary): guardrails, snapshot cadence,
+            # fault injection, heartbeats, profiling, and the
+            # elastic/preemption boundary, in the registered order
+            # (`_build_hooks` — ordering is load-bearing). A hook may
+            # raise the loop's control-flow exceptions (_RegroupSignal,
+            # _GuardRollback, PreemptedError, DivergedError).
             done += n
             self._host_step += n
             self._epoch_done = done  # regroup attribution (fit's handler)
-            if self.snap_mgr.due(self._host_step):
-                # Meta (a full Config.to_dict) is built only when a snapshot
-                # actually fires — not on every window of the host hot loop.
-                self.snap_mgr.snapshot(
-                    self.state, self._host_step, self._snapshot_meta(epoch, done)
-                )
-            if self.fault is not None:
-                self.fault.on_step(self._host_step)
-            if self.heartbeat is not None:
-                # Boundary-to-boundary wall time per step since the last
-                # accepted beat, AFTER the fault hook so an injected delay
-                # is attributed to the step it fired at. Host-clock
-                # honesty: without fences (basic mode) this is a dispatch
-                # rate; sustained, backpressure makes it track the device
-                # rate.
-                now = time.perf_counter()
-                hb_steps += n
-                try:
-                    accepted = self.heartbeat.beat(
-                        self._host_step, (now - t_boundary) / hb_steps * 1e3
-                    )
-                except OSError:
-                    # Best-effort telemetry on a shared filesystem where
-                    # transient errors (NFS blip, quota) are routine — a
-                    # failed beat must never abort training. Logged once;
-                    # the monitor sees the gap as staleness.
-                    if not self._hb_write_failed:
-                        self._hb_write_failed = True
-                        log0("heartbeat write failed (suppressing further "
-                             "warnings)", exc_info=True)
-                    accepted = False
-                if accepted:
-                    t_boundary, hb_steps = now, 0
-            if self._step_profiler is not None:
-                self._step_profiler.on_step(self._host_step)
-            if self.elastic is not None:
-                # SIGTERM means "this rank leaves, the job continues":
-                # the elastic boundary replaces the whole-job preempt
-                # exit. May raise _RegroupSignal (survivor) or
-                # PreemptedError (leaver).
-                self._elastic_boundary(epoch, done)
-            elif self.preempt is not None and self.preempt.requested:
-                self._preempt_exit(epoch, done)
+            ev = StepEvent(epoch=epoch, done=done, n=n, window=window)
+            for hook in self._hooks:
+                hook.on_step_end(ev)
         stats = {
             "loss": float(ep_loss) / max(1, ep_steps) if ep_steps else 0.0,
             "accuracy": float(ep_correct) / ep_count if ep_count else 0.0,
@@ -1023,6 +1178,11 @@ class Trainer:
             "config": self.cfg.to_dict(),
             "seed": self.cfg.train.seed,
         }
+        if self._rollback_gen:
+            # A post-rollback save identifies its generation, so forensic
+            # tooling can align it with the tombstoned metrics/quarantine
+            # records of the pass it replaced.
+            meta["rollback_generation"] = self._rollback_gen
         membership = self._membership_meta(epoch, steps_done)
         if membership is not None:
             meta["membership"] = membership
@@ -1039,10 +1199,7 @@ class Trainer:
 
         log0("preemption: taking final snapshot at epoch %d step %d "
              "(global step %d)", epoch, steps_done, self._host_step)
-        self.snap_mgr.snapshot(
-            self.state, self._host_step, self._snapshot_meta(epoch, steps_done)
-        )
-        self.snap_mgr.wait()
+        self._take_snapshot(epoch, steps_done, wait=True)
         try:
             res = self.cfg.resilience
             dist.fault_tolerant_barrier(
@@ -1076,11 +1233,14 @@ class Trainer:
                 self.elastic.mark_suspect(issue.rank, issue.describe())
 
     def _leave_requested(self) -> bool:
-        """This rank was told to go: SIGTERM (elastic semantics) or the
-        ``leave:`` fault injection."""
+        """This rank was told to go: SIGTERM (elastic semantics), the
+        ``leave:`` fault injection, or the SDC audit named it corrupt
+        (`GuardHook._sdc_audit` — a replica holding divergent params must
+        leave before it poisons another gradient reduction)."""
         return (
             (self.preempt is not None and self.preempt.requested)
             or (self.fault is not None and self.fault.leave_requested)
+            or self._guard_evict
         )
 
     def _elastic_boundary(self, epoch: int, done: int) -> None:
@@ -1108,8 +1268,13 @@ class Trainer:
                 log0("elastic: regroup trigger %r at epoch %d step %d "
                      "(global step %d)", trigger, epoch, done,
                      self._host_step)
+                # Rollback flavor: a suspected-dead peer, or an SDC
+                # eviction (the corrupt rank leaves AND everyone resumes
+                # from a pre-corruption save — a graceful final snapshot
+                # would persist the very state the audit condemned).
                 self._q_flavor = (
-                    "rollback" if trigger == "suspect" else "graceful"
+                    "rollback" if trigger == "suspect" or self._guard_evict
+                    else "graceful"
                 )
             plan = el.quiesce_step(
                 epoch, self._host_step, leaving=leaving,
@@ -1118,7 +1283,13 @@ class Trainer:
             if plan is None:
                 return  # keep stepping; the next boundary re-converges
             self._quiesce_plan = plan
-        if plan.flavor == "rollback" or self._host_step >= plan.stop_step:
+        # A rollback plan finishes immediately only when members DEPARTED
+        # (the mesh is already broken — further steps are impossible);
+        # a live-membered rollback (SDC eviction) converges at the common
+        # stop threshold like a graceful one — stopping this rank early
+        # would wedge every still-stepping peer's in-flight collective.
+        if (plan.flavor == "rollback" and plan.departed) \
+                or self._host_step >= plan.stop_step:
             self._finish_quiesce(epoch, done, plan)
 
     def _finish_quiesce(self, epoch: int, done: int, plan) -> None:
@@ -1151,11 +1322,7 @@ class Trainer:
             # pre-publish validation sees the missing snapshot and falls
             # back to a rollback resume.
             try:
-                self.snap_mgr.snapshot(
-                    self.state, self._host_step,
-                    self._snapshot_meta(epoch, done)
-                )
-                self.snap_mgr.wait()
+                self._take_snapshot(epoch, done, wait=True)
             except Exception:
                 log0("elastic: final snapshot at step %d failed — the "
                      "regroup will resume from the newest complete one",
@@ -1245,6 +1412,134 @@ class Trainer:
             }
         return {"epoch": 0, "steps_done": 0, "lineage": [],
                 "global_step": 0, "snapshot_dir": None}
+
+    def _execute_guard_rollback(self, sig: _GuardRollback) -> tuple[int, int]:
+        """Rewind to the newest complete, non-quarantined save and replay.
+
+        The guard's auto-rollback (guard.action=rollback): every rank
+        reaches the identical decision at the identical boundary (the
+        policy consumes replicated values), so the rewind needs no
+        coordination beyond agreeing on the resume source — local
+        `_rollback_resume` where the checkpoint tree is shared (elastic /
+        single process), rank-0-decides + broadcast otherwise (each host
+        has its own disk; only rank 0's saves exist). Returns the
+        ``(epoch, start_step)`` to continue from; the rolled-back steps'
+        records are tombstoned and every later record carries the bumped
+        ``rollback_generation``.
+        """
+        from_step = self._host_step
+        hook = self._guard_hook
+        # Budget check first: past max_rollbacks without progress this
+        # raises DivergedError — a deterministic divergence replays
+        # identically and rolling back into it forever is a livelock.
+        hook.policy.on_rollback()
+        if self.fault is not None:
+            # The guard hook raises before the fault hook's disarm runs at
+            # this boundary; without this, the replay would re-arm the
+            # injected nan/spike seam and re-poison the very step being
+            # rewound — an injected fault fires once per run, period.
+            self.fault.disarm_device(from_step)
+        log0("guard: rolling back from step %d — %s", from_step,
+             sig.trigger.reason)
+        if self.elastic is not None or self.ctx.process_count == 1:
+            resume = self._rollback_resume()
+            if resume.get("snapshot_dir"):
+                self.state, _ = ckpt_lib.load_checkpoint(
+                    Path(resume["snapshot_dir"]), self.state
+                )
+                self.state = self._place_state(self.state)
+            else:
+                rng = jax.random.PRNGKey(self.cfg.train.seed)
+                sample = np.zeros((1, 32, 32, 3), np.float32)
+                self.state = create_train_state(
+                    self._init_model, rng, sample, self.optimizer
+                )
+        else:
+            from jax.experimental import multihost_utils
+
+            # Non-elastic multi-process: no shared-filesystem requirement,
+            # so the resume decision AND the restored state come from the
+            # save writer (rank 0), like `_maybe_resume`.
+            if self.ctx.process_index == 0:  # dplint: allow(DP101)
+                resume = self._rollback_resume()
+                state = self.state
+                if resume.get("snapshot_dir"):
+                    state, _ = ckpt_lib.load_checkpoint(
+                        Path(resume["snapshot_dir"]), self.state
+                    )
+                else:
+                    state = create_train_state(
+                        self._init_model,
+                        jax.random.PRNGKey(self.cfg.train.seed),
+                        np.zeros((1, 32, 32, 3), np.float32), self.optimizer,
+                    )
+                pos = np.asarray([resume["epoch"], resume["steps_done"],
+                                  resume["global_step"]], np.int32)
+            else:
+                state, pos = self.state, np.zeros(3, np.int32)
+            host_state = jax.tree_util.tree_map(np.asarray, state)
+            self.state = self._place_state(
+                multihost_utils.broadcast_one_to_all(host_state)
+            )
+            pos = multihost_utils.broadcast_one_to_all(pos)
+            resume = {"epoch": int(pos[0]), "steps_done": int(pos[1]),
+                      "global_step": int(pos[2]), "lineage": []}
+        self._host_step = int(resume.get("global_step", 0))
+        self._epoch_done = int(resume.get("steps_done", 0))
+
+        epoch = int(resume.get("epoch", 0))
+        lineage = resume.get("lineage") or []
+        if lineage:
+            # The save predates (or spans) an elastic re-split: reinstall
+            # the interrupted epoch's tail exactly like a regroup resume.
+            has_tail = self._set_elastic_tail(epoch, lineage)
+            position = (epoch, 0) if has_tail else (epoch + 1, 0)
+        else:
+            self._epoch_lineage = []
+            self._elastic_tail = None
+            position = (epoch, int(resume.get("steps_done", 0)))
+
+        # Rewind bookkeeping: the generation bump + tombstone make the
+        # rolled-back records identifiable (metrics sink, quarantine log,
+        # heartbeats), and the cadence markers re-arm below the old
+        # high-water step so the replay is snapshotted/beaten too.
+        self._rollback_gen += 1
+        if self.ctx.process_index == 0:  # dplint: allow(DP101) host-only IO
+            hook.log.tombstone(
+                from_step=from_step, to_step=self._host_step,
+                reason=sig.trigger.reason,
+            )
+        hook.log.generation = self._rollback_gen
+        if self.heartbeat is not None:
+            self.heartbeat.rewind(self._host_step)
+        self.snap_mgr.rewind(self._host_step)
+        hook.on_rollback_rewind(self._host_step)
+        if self.elastic is not None:
+            # Same rewind contract for the ledger-poll cadence: its
+            # crossing marker would otherwise sit at the pre-rollback
+            # high-water step and suppress peer/suspect detection for the
+            # whole replay window.
+            self.elastic.rewind_poll(self._host_step)
+        hook.arm_lr_ease(self._host_step)
+        _obs_counters.inc("guard.rollbacks")
+        if self.spans is not None:
+            self.spans.record_window(
+                self._host_step, 1,
+                {"guard_rollback": 0.0},
+            )
+        self._log_metrics({
+            "event": "guard_rollback",
+            "from_step": from_step,
+            "to_step": self._host_step,
+            "trigger": sig.trigger.reason,
+            "resume_epoch": position[0],
+            "resume_step": position[1],
+        })
+        log0("guard: rolled back %d step(s) — resuming at epoch %d step %d "
+             "(global step %d, generation %d)",
+             from_step - self._host_step, position[0], position[1],
+             self._host_step, self._rollback_gen)
+        return position
 
     def _execute_regroup(self, sig: _RegroupSignal) -> tuple[int, int]:
         """Shrink the mesh to the survivors and continue the run.
@@ -1346,6 +1641,13 @@ class Trainer:
         # reassigned dense rank must not append into another rank's
         # stream), the monitor follows the new world/leader.
         self._rebuild_observers(record)
+        # Guardrail re-homing: the compiled checksum and the audit
+        # baseline are topology-bound; the eviction flag (if this rank
+        # survived an SDC regroup it was not the suspect) resets.
+        self._guard_evict = False
+        self._sdc_suspect_active = False
+        if self._guard_hook is not None:
+            self._guard_hook.on_regroup()
 
         # DP304 on the shrunk mesh, before the first post-regroup step: a
         # survivor about to run a different collective schedule fails here,
@@ -1464,6 +1766,11 @@ class Trainer:
             return
         rec = {"ts": _iso_ts(time.time()), "step": self._host_step,
                "schema": 2}
+        if self._rollback_gen:
+            # Rewind guard: post-rollback records name their generation so
+            # consumers can drop the tombstoned (replayed-over) steps
+            # instead of double-counting them (docs/OBSERVABILITY.md).
+            rec["rollback_generation"] = self._rollback_gen
         if self.elastic is not None:
             # Every record carries the membership epoch, so a metrics
             # stream that spans a shrink explains its own discontinuities
@@ -1580,6 +1887,12 @@ class Trainer:
                         # mesh and continue — the regroup-aware fit loop.
                         epoch, start_step = self._execute_regroup(sig)
                         continue
+                    except _GuardRollback as sig:
+                        # The guard policy condemned the trajectory:
+                        # rewind to the newest trusted save and replay
+                        # (may raise DivergedError past the budget).
+                        epoch, start_step = self._execute_guard_rollback(sig)
+                        continue
                     except fatal as e:
                         try:
                             self._elastic_rollback(epoch, e)
@@ -1695,6 +2008,11 @@ class Trainer:
                     self._metrics_file.close()
                 except OSError:
                     log0("metrics sink close failed", exc_info=True)
+            for hook in self._hooks:
+                try:
+                    hook.close()
+                except Exception:
+                    log0("step hook close failed", exc_info=True)
             if self.elastic is not None:
                 # Every elastic exit path — leaver, survivor, crash — pins
                 # the live coordination objects so interpreter teardown

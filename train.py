@@ -27,7 +27,7 @@ import json
 import sys
 
 from tpu_dp.config import parse_cli
-from tpu_dp.resilience import PreemptedError
+from tpu_dp.resilience import DivergedError, PreemptedError
 from tpu_dp.train.trainer import Trainer
 from tpu_dp.utils import print0
 
@@ -43,6 +43,13 @@ def main(argv=None) -> int:
         # (with --resume=auto) instead of flagging a failure.
         print0(f"preempted: {e}")
         return PreemptedError.exit_code
+    except DivergedError as e:
+        # Guardrail halt: training is mathematically compromised (NaN
+        # storm, unrecoverable divergence, SDC). Exit 65 (EX_DATAERR) —
+        # deliberately NOT 143 — so a supervisor does not auto-restart
+        # into the same divergence (docs/RESILIENCE.md "Guardrails").
+        print0(f"diverged: {e}")
+        return DivergedError.exit_code
     summary = {
         "model": cfg.model.name,
         "dataset": trainer.train_ds.name,
@@ -54,6 +61,19 @@ def main(argv=None) -> int:
         if result["history"] else None,
         "eval": result.get("eval"),
     }
+    if trainer.guard_enabled:
+        # Guardrail rollup: quarantines/rollbacks/audits must be visible
+        # in the one-line summary, not only in quarantine.jsonl.
+        from tpu_dp.obs.counters import counters as obs_counters
+
+        summary["guard"] = {
+            "quarantined": int(obs_counters.get("guard.quarantined")),
+            "spikes": int(obs_counters.get("guard.spike")),
+            "rollbacks": int(obs_counters.get("guard.rollbacks")),
+            "sdc_audits": int(obs_counters.get("guard.sdc_audits")),
+            "sdc_mismatches": int(obs_counters.get("guard.sdc_mismatches")),
+            "quarantine_log": str(trainer.quarantine_path),
+        }
     obs = trainer.obs_summary()
     if obs is not None:
         # Telemetry rollup (train.obs=basic|full): span percentiles +
