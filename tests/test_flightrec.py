@@ -1,0 +1,294 @@
+"""Flight recorder (`tpu_dp.obs.flightrec`, ISSUE 9).
+
+The acceptance property: EVERY exit path out of a training process —
+clean completion, `PreemptedError` (self-injected SIGTERM), a real
+external SIGTERM, `DivergedError`, and an unhandled exception — leaves
+an atomic, schema-versioned ``flightrec_r<rank>.json`` whose event tail
+matches the live metrics records; plus the ring/dump/sentinel unit
+contracts.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpu_dp.obs import flightrec
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    flightrec.recorder.reset()
+    yield
+    flightrec.recorder.reset()
+
+
+# -- ring / dump units -----------------------------------------------------
+
+def test_ring_bounds_and_lifetime_count():
+    fr = flightrec.FlightRecorder(capacity=4)
+    for i in range(7):
+        fr.record("step", step=i)
+    assert len(fr) == 4 and fr.total_recorded == 7
+    assert [e["step"] for e in fr.events()] == [3, 4, 5, 6]
+    assert all(e["kind"] == "step" and e["ts"] > 0 for e in fr.events())
+
+
+def test_dump_atomic_schema_and_roundtrip(tmp_path):
+    fr = flightrec.FlightRecorder(capacity=8)
+    fr.configure(rank=3, dump_dir=tmp_path, run={"model": "net"})
+    fr.record("guard_trigger", step=5, trigger="spike")
+    out = fr.dump(reason="unit test")
+    assert out == tmp_path / "flightrec_r00003.json"
+    assert not list(tmp_path.glob("*.tmp*"))  # atomic rename, no residue
+    payload = flightrec.read_dump(out)
+    assert payload["schema"] == flightrec.SCHEMA
+    assert payload["rank"] == 3 and payload["reason"] == "unit test"
+    assert payload["run"] == {"model": "net"}
+    assert payload["events"][-1]["kind"] == "guard_trigger"
+    assert isinstance(payload["counters"], dict)
+    # A foreign schema is refused, never misread.
+    bad = tmp_path / "flightrec_r00009.json"
+    bad.write_text(json.dumps({"schema": 999}))
+    with pytest.raises(ValueError, match="schema"):
+        flightrec.read_dump(bad)
+
+
+def test_dump_survives_numpy_fields(tmp_path):
+    fr = flightrec.FlightRecorder()
+    fr.configure(rank=0, dump_dir=tmp_path)
+    fr.record("guard_sdc", step=2, suspects=[np.int64(2)],
+              value=np.float32(1.5))
+    payload = flightrec.read_dump(fr.dump(reason="numpy"))
+    ev = payload["events"][-1]
+    assert ev["suspects"] == [2] and ev["value"] == 1.5
+
+
+def test_dump_without_target_returns_none():
+    fr = flightrec.FlightRecorder()
+    fr.record("step", step=1)
+    assert fr.dump(reason="nowhere") is None  # never raises either
+
+
+def test_configure_preserves_ring_across_rehome(tmp_path):
+    fr = flightrec.FlightRecorder(capacity=8)
+    fr.record("step", step=1)
+    fr.configure(rank=1, dump_dir=tmp_path)
+    assert [e["step"] for e in fr.events()] == [1]  # regroup keeps history
+    fr.configure(rank=1, dump_dir=tmp_path, capacity=2)
+    fr.record("step", step=2)
+    fr.record("step", step=3)
+    assert [e["step"] for e in fr.events()] == [2, 3]
+
+
+def test_dump_request_sentinel_honored_once_per_write(tmp_path):
+    fr = flightrec.FlightRecorder()
+    fr.configure(rank=0, dump_dir=tmp_path)
+    assert fr.poll_dump_request() is None  # no sentinel, one stat only
+    flightrec.write_dump_request(tmp_path, "rank 1 heartbeat stale")
+    out = fr.poll_dump_request()
+    assert out is not None
+    payload = flightrec.read_dump(out)
+    assert "rank 1 heartbeat stale" in payload["reason"]
+    assert fr.poll_dump_request() is None  # same sentinel: honored once
+    time.sleep(0.01)
+    flightrec.write_dump_request(tmp_path, "again")
+    os.utime(tmp_path / flightrec.DUMP_REQUEST)  # ensure fresh mtime
+    assert fr.poll_dump_request() is not None  # a new request re-dumps
+
+
+def test_health_monitor_requests_dump_only_for_hangs(tmp_path):
+    from tpu_dp.obs.health import HealthIssue, HealthMonitor
+
+    mon = HealthMonitor(tmp_path, world=2)
+    straggler = HealthIssue(kind="straggler", rank=1, step=3, ratio=4.0)
+    assert mon.request_dump([straggler]) is None  # slow ≠ dead: no dump
+    stale = HealthIssue(kind="stale", rank=1, step=3, age_s=120.0)
+    sentinel = mon.request_dump([straggler, stale])
+    assert Path(sentinel).name == flightrec.DUMP_REQUEST
+    assert "rank 1" in json.loads(Path(sentinel).read_text())["reason"]
+
+
+# -- exit paths ------------------------------------------------------------
+
+_CLI_COMMON = [
+    "--data.dataset=synthetic",
+    "--data.synthetic_train_size=64",
+    "--data.synthetic_test_size=16",
+    "--data.batch_size=8",
+    "--train.epochs=2",
+    "--train.log_every=100",
+    "--train.eval_at_end=false",
+    "--train.obs=full",
+    "--train.steps_per_call=1",
+]
+
+
+def _train_cmd(ckpt_dir, *extra):
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("TPU_DP_FAULT", None)
+    env["PYTHONPATH"] = (f"{repo}{os.pathsep}{env['PYTHONPATH']}"
+                         if env.get("PYTHONPATH") else str(repo))
+    return ([sys.executable, str(repo / "train.py"),
+             f"--train.ckpt_dir={ckpt_dir}", *_CLI_COMMON, *extra],
+            repo, env)
+
+
+def _assert_blackbox(ckpt_dir, expect_reason):
+    """The dump exists, parses, is schema-versioned, and its step-event
+    tail matches the live metrics records (the last step the black box
+    saw is the last step rank 0 logged)."""
+    dump_path = Path(ckpt_dir) / "obs" / "flightrec_r00000.json"
+    assert dump_path.exists(), "dead rank left no black box"
+    payload = flightrec.read_dump(dump_path)  # parses + schema-checked
+    assert expect_reason in payload["reason"]
+    metrics = [json.loads(l) for l in
+               (Path(ckpt_dir) / "metrics.jsonl").read_text().splitlines()]
+    step_events = [e for e in payload["events"] if e["kind"] == "step"]
+    per_step = [r for r in metrics if "spans" in r and "epoch" not in r]
+    assert step_events and per_step
+    assert step_events[-1]["step"] == per_step[-1]["step"]
+    # The exit itself is the final recorded event.
+    assert payload["events"][-1]["kind"] == "exit"
+    assert expect_reason in payload["events"][-1]["reason"]
+    return payload
+
+
+@pytest.mark.parametrize("fault,extra,rc,reason", [
+    # PreemptedError: the injector SIGTERMs self; the handler's boundary
+    # raise runs the snapshot-exit-143 contract — and the dump.
+    ("preempt:step=5", [], 143, "PreemptedError"),
+    # DivergedError: a NaN loss under guard.action=halt exits 65.
+    ("nan:step=3", ["--guard.enabled=true", "--guard.action=halt",
+                    "--parallel.num_devices=1"], 65, "DivergedError"),
+])
+def test_dump_on_faulted_exit_paths(tmp_path, fault, extra, rc, reason):
+    ckpt = tmp_path / "ck"
+    argv, repo, env = _train_cmd(ckpt, f"--resilience.fault={fault}", *extra)
+    proc = subprocess.run(argv, cwd=repo, env=env, capture_output=True,
+                          text=True, timeout=240)
+    assert proc.returncode == rc, proc.stdout + proc.stderr
+    payload = _assert_blackbox(ckpt, reason)
+    if reason == "PreemptedError":
+        kinds = [e["kind"] for e in payload["events"]]
+        # The handler stamped the signal AND the boundary stamped the exit
+        # decision — the black box shows the causal chain, not just death.
+        assert "preempt_signal" in kinds and "preempt_exit" in kinds
+    if reason == "DivergedError":
+        kinds = [e["kind"] for e in payload["events"]]
+        assert "guard_trigger" in kinds and "guard_halt" in kinds
+
+
+def test_dump_on_external_sigterm(tmp_path):
+    """A REAL external SIGTERM (not the injector): the delay fault parks
+    the run at a boundary long enough for the signal to land mid-train."""
+    ckpt = tmp_path / "ck"
+    argv, repo, env = _train_cmd(
+        ckpt, "--resilience.fault=delay:step=3,ms=3000")
+    proc = subprocess.Popen(argv, cwd=repo, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    hb = ckpt / "obs" / "heartbeat_r00000.jsonl"
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        if hb.exists() and hb.read_text().count("\n") >= 2:
+            break
+        if proc.poll() is not None:
+            break
+        time.sleep(0.05)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=240)
+    assert proc.returncode == 143, out
+    payload = _assert_blackbox(ckpt, "PreemptedError")
+    assert any(e["kind"] == "preempt_signal" for e in payload["events"])
+
+
+def test_dump_on_unhandled_exception_in_process(tmp_path):
+    """An arbitrary crash inside the epoch loop still leaves the black
+    box, stamped with the exception — fit()'s finally owns the dump, not
+    any particular error type."""
+    from tpu_dp.train.hooks import StepHook
+    from tpu_dp.train.trainer import Trainer
+    from tpu_dp.config import Config
+
+    c = Config()
+    c.data.dataset = "synthetic"
+    c.data.synthetic_train_size = 32
+    c.data.synthetic_test_size = 16
+    c.data.batch_size = 8
+    c.train.epochs = 1
+    c.train.log_every = 100
+    c.train.eval_at_end = False
+    c.train.ckpt_dir = str(tmp_path / "ck")
+    tr = Trainer(c)
+
+    class Bomb(StepHook):
+        def on_step_end(self, ev):
+            if self.tr._host_step >= 2:
+                raise RuntimeError("simulated data-loader corruption")
+
+    tr._hooks.insert(0, Bomb(tr))
+    with pytest.raises(RuntimeError, match="corruption"):
+        tr.fit()
+    dump = flightrec.read_dump(
+        tmp_path / "ck" / "obs" / "flightrec_r00000.json"
+    )
+    assert "RuntimeError" in dump["reason"]
+    assert "corruption" in dump["reason"]
+    assert dump["events"][-1]["kind"] == "exit"
+
+
+def test_dump_on_clean_exit_and_disable_knob(tmp_path):
+    """A clean run leaves a black box too (reason "clean") — obsctl's
+    timeline needs the completion evidence; flightrec_capacity=0 turns
+    the whole layer off."""
+    from tpu_dp.config import Config
+    from tpu_dp.train.trainer import Trainer
+
+    c = Config()
+    c.data.dataset = "synthetic"
+    c.data.synthetic_train_size = 32
+    c.data.synthetic_test_size = 16
+    c.data.batch_size = 8
+    c.train.epochs = 1
+    c.train.log_every = 100
+    c.train.eval_at_end = False
+    c.train.ckpt_dir = str(tmp_path / "ck")
+    Trainer(c).fit()
+    dump = flightrec.read_dump(
+        tmp_path / "ck" / "obs" / "flightrec_r00000.json"
+    )
+    assert dump["reason"] == "clean"
+    assert {"epoch_start", "step", "exit"} <= {e["kind"]
+                                              for e in dump["events"]}
+
+    flightrec.recorder.reset()
+    c2 = Config()
+    c2.data.dataset = "synthetic"
+    c2.data.synthetic_train_size = 32
+    c2.data.synthetic_test_size = 16
+    c2.data.batch_size = 8
+    c2.train.epochs = 1
+    c2.train.log_every = 100
+    c2.train.eval_at_end = False
+    c2.train.ckpt_dir = str(tmp_path / "ck2")
+    c2.obs.flightrec_capacity = 0
+    tr2 = Trainer(c2)
+    assert tr2.flightrec is None
+    tr2.fit()
+    assert not list((tmp_path / "ck2").rglob("flightrec_r*.json"))
+    # Disabled means DISABLED: the subsystems' module-level record()
+    # calls were no-ops, not silent in-memory accumulation.
+    assert flightrec.recorder.total_recorded == 0
+    assert len(flightrec.recorder) == 0

@@ -858,6 +858,50 @@ def test_three_process_sdc_audit_names_rank2_and_regroups(tmp_path):
     ev = [m for m in metrics if m.get("event") == "guard_sdc"]
     assert ev and ev[0]["suspects"] == [2]
 
+    # --- ISSUE 9 acceptance: black boxes + the obsctl timeline ---------
+    # Every rank left a flight-recorder dump — the evicted rank's exit
+    # path (PreemptedError, 143) AND the survivors' clean completions.
+    from tpu_dp.obs import flightrec, obsctl
+
+    ck = tmp_path / "ck"
+    dumps = {}
+    for d in sorted((ck / "obs").glob("flightrec_r*.json")):
+        payload = flightrec.read_dump(d)
+        dumps[payload["rank"]] = payload
+    assert sorted(dumps) == [0, 1, 2], "a rank left no black box"
+    assert "PreemptedError" in dumps[2]["reason"]
+    assert all(dumps[r]["reason"] == "clean" for r in (0, 1))
+    assert any(e["kind"] == "guard_evict" for e in dumps[2]["events"])
+
+    # `obsctl timeline` over NOTHING but the artifacts directory
+    # reconstructs the ordered story: divergence detected -> rank
+    # attributed -> eviction -> rollback resume -> completion.
+    out = obsctl.build_timeline(obsctl.RunArtifacts(ck),
+                                include_steps=True)
+    events = out["events"]
+    assert [e["ts"] for e in events] == sorted(e["ts"] for e in events)
+    kinds = [e["kind"] for e in events]
+    story = ["guard_sdc", "eviction", "elastic_regroup", "epoch_complete"]
+    positions = [kinds.index(k) for k in story]
+    assert positions == sorted(positions), (
+        f"story out of order: {list(zip(story, positions))}"
+    )
+    sdc_ev = events[kinds.index("guard_sdc")]
+    assert sdc_ev["detail"]["suspects"] == [2]  # rank attributed
+    evict = next(e for e in events if e["kind"] == "eviction")
+    assert evict["rank"] == 2 and "sdc" in evict["detail"]["reason"]
+    regroup = next(e for e in events if e["kind"] == "elastic_regroup")
+    assert regroup["detail"]["flavor"] == "rollback"  # rollback resume
+    exits = [e for e in events if e["kind"] == "exit"]
+    assert sum(1 for e in exits
+               if e["detail"]["reason"] == "clean") == 2  # completion
+    # No duplicate replayed-step events: the post-eviction world replayed
+    # steps past the rollback point, yet each optimizer step appears
+    # exactly once (the surviving membership-epoch attempt wins).
+    steps = [e["step"] for e in events if e["kind"] == "step"]
+    assert steps and len(steps) == len(set(steps))
+    assert out["stats"]["steps"]["replayed_beats_deduped"] > 0
+
 
 @pytest.mark.slow
 def test_two_process_fused_conv_step(tmp_path):

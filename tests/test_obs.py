@@ -542,7 +542,7 @@ def test_trainer_obs_full_end_to_end(tmp_path):
     tr.fit()
 
     records = _read_metrics(tmp_path / "ck" / "metrics.jsonl")
-    assert all(r["schema"] == 2 for r in records)
+    assert all(r["schema"] == 3 for r in records)
     for r in records:  # ts parses as ISO-8601
         datetime.fromisoformat(r["ts"])
     per_step = [r for r in records if "spans" in r and "epoch" not in r]
@@ -582,11 +582,16 @@ def test_trainer_obs_off_is_untelemetered(tmp_path):
     tr.fit()
     records = _read_metrics(tmp_path / "ck" / "metrics.jsonl")
     # Schema stamps are unconditional (the satellite fix)…
-    assert all(r["schema"] == 2 and "ts" in r and "step" in r
+    assert all(r["schema"] == 3 and "ts" in r and "step" in r
                for r in records)
-    # …but there are no per-step records, no spans, and no telemetry dir.
+    # …but there are no per-step records, no spans, and no live-telemetry
+    # artifacts — the only obs-dir inhabitant at obs=off is the
+    # always-on flight-recorder dump (crash forensics are deliberately
+    # NOT gated by train.obs; docs/OBSERVABILITY.md "Flight recorder").
     assert [r for r in records if "spans" in r] == []
-    assert not (tmp_path / "ck" / "obs").exists()
+    assert [p.name for p in (tmp_path / "ck" / "obs").iterdir()] == [
+        "flightrec_r00000.json"
+    ]
     assert tr.obs_summary() is None
 
 

@@ -1,0 +1,333 @@
+"""obsctl — the post-hoc forensic CLI (`python -m tpu_dp.obs`, ISSUE 9).
+
+Two layers of evidence: a REAL guard-rollback run (obs=full) whose
+artifacts the timeline / merge-trace / diff commands must reconstruct
+with no duplicate replayed-step events, and synthetic multi-rank
+artifact trees that pin the cross-source merge (metrics + quarantine +
+membership ledger + flight dumps + per-membership-epoch heartbeats) and
+the eviction-story ordering. Plus the Prometheus textfile exporter.
+"""
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_dp.obs import obsctl
+
+pytestmark = pytest.mark.obs
+
+
+# -- a real rollback run (shared fixture) ----------------------------------
+
+@pytest.fixture(scope="module")
+def rollback_run(tmp_path_factory):
+    """One guard spike-rollback run at obs=full: a 1e6x loss spike at
+    step 8 triggers rewind to the step-5 snapshot and a replay — real
+    rollback generations in every artifact."""
+    from tpu_dp.config import Config
+    from tpu_dp.train.trainer import Trainer
+
+    tmp = tmp_path_factory.mktemp("obsctl_run")
+    cfg = Config()
+    cfg.data.dataset = "synthetic"
+    cfg.data.synthetic_train_size = 128
+    cfg.data.synthetic_test_size = 16
+    cfg.data.batch_size = 4
+    cfg.data.device_resident = "off"
+    cfg.train.epochs = 2
+    cfg.train.log_every = 1000
+    cfg.train.eval_at_end = False
+    cfg.train.steps_per_call = 1
+    cfg.train.ckpt_dir = str(tmp / "ck")
+    cfg.train.ckpt_async = False
+    cfg.train.obs = "full"
+    cfg.parallel.num_devices = 1
+    cfg.guard.enabled = True
+    cfg.guard.action = "rollback"
+    cfg.guard.spike_min_steps = 4
+    cfg.guard.spike_z = 12
+    cfg.resilience.snapshot_every_steps = 5
+    cfg.resilience.fault = "spike:step=8,scale=1e6"
+    Trainer(cfg).fit()
+    return tmp / "ck"
+
+
+def test_timeline_reconstructs_rollback_story(rollback_run, capsys):
+    rc = obsctl.main(["timeline", str(rollback_run), "--json", "--steps"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    events, stats = out["events"], out["stats"]
+    # Ordered by wall clock.
+    assert [e["ts"] for e in events] == sorted(e["ts"] for e in events)
+    kinds = [e["kind"] for e in events]
+    # The story: spike detected -> rollback -> tombstone -> replay ->
+    # completion, all from the artifacts directory alone.
+    assert "guard_spike" in kinds
+    assert "guard_rollback" in kinds
+    assert "guard_tombstone" in kinds
+    assert kinds.index("guard_spike") < kinds.index("guard_rollback")
+    assert "epoch_complete" in kinds and "exit" in kinds
+    exits = [e for e in events if e["kind"] == "exit"]
+    assert any(e["detail"]["reason"] == "clean" for e in exits)
+    # No duplicate replayed-step events: the rollback replayed steps
+    # 6..8, yet each optimizer step appears EXACTLY once (the surviving
+    # generation), and the dedup is visible in the stats.
+    steps = [e["step"] for e in events if e["kind"] == "step"]
+    assert len(steps) == len(set(steps))
+    assert stats["steps"]["replayed_beats_deduped"] > 0
+    assert stats["steps"]["distinct"] == len(steps)
+    # Replayed steps carry the surviving generation stamp.
+    replayed = [e for e in events
+                if e["kind"] == "step" and e.get("gen") == 1]
+    assert replayed, "replay attempt did not win the dedup"
+    # Swept per-step metrics: no rolled-back generation-0 record above
+    # the rewind point survives into the timeline's metrics view.
+    rb = next(e for e in events if e["kind"] == "guard_rollback")
+    to_step = rb["detail"]["to_step"]
+    assert all(e["step"] <= to_step for e in events
+               if e["kind"] == "step" and not e.get("gen"))
+
+
+def test_merge_trace_spans_generations_with_markers(rollback_run, tmp_path,
+                                                    capsys):
+    from tpu_dp.obs.export import validate_trace
+
+    out_path = tmp_path / "merged.json"
+    rc = obsctl.main(["merge-trace", str(rollback_run), "-o",
+                      str(out_path)])
+    assert rc == 0
+    trace = json.loads(out_path.read_text())
+    assert validate_trace(trace) == []
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    # Rollback generation 1 renders as its own track group.
+    assert any("[gen 1]" in n for n in names)
+    # Eviction/rollback-class markers are instant events.
+    instants = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+    assert any(e["name"] == "guard_rollback" for e in instants)
+
+
+def test_diff_clean_vs_regressed_exit_codes(rollback_run, tmp_path, capsys):
+    base = tmp_path / "base.json"
+    assert obsctl.main(["diff", str(rollback_run),
+                        "--write-baseline", str(base)]) == 0
+    payload = json.loads(base.read_text())
+    assert payload["goodput"] is not None and payload["p95_ms"] is not None
+    capsys.readouterr()
+
+    # Clean: the run against its own baseline.
+    assert obsctl.main(["diff", str(rollback_run), "--baseline",
+                        str(base), "--json"]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["regressed"] is False and verdict["compared"] >= 2
+
+    # Synthetically regressed: the baseline demands a p95 this run
+    # exceeds by >tolerance -> nonzero exit, CI gate trips.
+    tampered = dict(payload, p95_ms=payload["p95_ms"] / 10.0)
+    base.write_text(json.dumps(tampered))
+    assert obsctl.main(["diff", str(rollback_run), "--baseline",
+                        str(base), "--json"]) == 1
+    verdict = json.loads(capsys.readouterr().out)
+    bad = [c for c in verdict["checks"] if c["verdict"] == "regressed"]
+    assert [c["signal"] for c in bad] == ["p95_ms"]
+
+    # A BENCH_*.json-shaped baseline (latency.p95_ms) parses too.
+    bench_shape = {"mfu": None, "goodput": payload["goodput"],
+                   "latency": {"p95_ms": payload["p95_ms"]}}
+    base.write_text(json.dumps(bench_shape))
+    assert obsctl.main(["diff", str(rollback_run), "--baseline",
+                        str(base)]) == 0
+
+    # Nothing comparable on both sides: refuse to certify (exit 2).
+    base.write_text(json.dumps({"note": "no signals"}))
+    assert obsctl.main(["diff", str(rollback_run), "--baseline",
+                        str(base)]) == 2
+    # Missing run dir: usage error, not a traceback.
+    assert obsctl.main(["timeline", str(tmp_path / "nope")]) == 2
+
+
+# -- synthetic multi-rank artifacts (cross-source merge) -------------------
+
+def _beat(d, rank, step, ts, step_ms=10.0, gen=None):
+    rec = {"rank": rank, "step": step, "ts": ts, "step_ms": step_ms}
+    if gen:
+        rec["gen"] = gen
+    path = d / f"heartbeat_r{rank:05d}.jsonl"
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+@pytest.fixture()
+def sdc_artifacts(tmp_path):
+    """A hand-built 3-rank SDC-eviction artifact tree: divergence
+    detected -> suspect attributed -> eviction -> rollback regroup ->
+    completion, spread across every source obsctl merges."""
+    run = tmp_path / "run"
+    obs = run / "obs"
+    obs.mkdir(parents=True)
+    t0 = 1000.0
+
+    def iso(ts):
+        from datetime import datetime, timezone
+
+        return datetime.fromtimestamp(ts, timezone.utc).isoformat(
+            timespec="milliseconds")
+
+    # me0: 3 ranks, steps 1..3.
+    for rank in range(3):
+        for step in (1, 2, 3):
+            _beat(obs, rank, step, t0 + step)
+    # me1 (post-eviction, world 2, reassigned ranks): replays 2..5.
+    me1 = obs / "me0001"
+    me1.mkdir()
+    for rank in range(2):
+        for step in (2, 3, 4, 5):
+            _beat(me1, rank, step, t0 + 20 + step)
+
+    (run / "metrics.jsonl").write_text("\n".join([
+        json.dumps({"ts": iso(t0 + 4), "step": 3, "schema": 3,
+                    "event": "guard_sdc", "suspects": [2], "majority":
+                    "a1b2"}),
+        json.dumps({"ts": iso(t0 + 30), "step": 2, "schema": 3,
+                    "event": "elastic_regroup", "membership_epoch": 1,
+                    "flavor": "rollback", "world": 2}),
+        json.dumps({"ts": iso(t0 + 40), "step": 5, "schema": 3,
+                    "epoch": 1, "loss": 1.5, "accuracy": 0.5}),
+    ]) + "\n")
+    (run / "quarantine.jsonl").write_text(json.dumps({
+        "kind": "sdc", "ts": t0 + 4, "rollback_generation": 0, "step": 3,
+        "suspects": [2],
+    }) + "\n")
+
+    gen_dir = run / "membership" / "gen_0000000000_w3_abc"
+    gen_dir.mkdir(parents=True)
+    (gen_dir / "epoch_0000.json").write_text(json.dumps({
+        "schema": 1, "epoch": 0, "members": [0, 1, 2], "world": 3,
+        "coordinator": None, "departed": [], "resume": None,
+        "reason": "initial", "ts": t0,
+    }))
+    (gen_dir / "epoch_0001.json").write_text(json.dumps({
+        "schema": 1, "epoch": 1, "members": [0, 1], "world": 2,
+        "coordinator": None,
+        "departed": [{"sid": 2, "reason": "sdc audit mismatch at step 3"}],
+        "resume": {"epoch": 0, "steps_done": 1}, "reason": "rollback",
+        "ts": t0 + 10,
+    }))
+
+    # The victim's black box (stable rank 2): eviction decision + exit.
+    (obs / "flightrec_r00002.json").write_text(json.dumps({
+        "schema": 1, "rank": 2, "reason": "PreemptedError: evicted",
+        "ts": t0 + 15, "run": {}, "total_recorded": 3, "counters": {},
+        "events": [
+            # Same replicated verdict the metrics stream already tells:
+            # must dedupe to ONE guard_sdc event, not world+1 copies.
+            {"ts": t0 + 4.2, "kind": "guard_sdc", "step": 3,
+             "suspects": [2], "majority": "a1b2"},
+            {"ts": t0 + 4.5, "kind": "guard_evict", "step": 3, "rank": 2,
+             "reason": "sdc audit suspect"},
+            {"ts": t0 + 14, "kind": "elastic_departure", "step": 3},
+        ],
+    }))
+    return run
+
+
+def test_timeline_orders_the_eviction_story(sdc_artifacts, capsys):
+    rc = obsctl.main(["timeline", str(sdc_artifacts), "--json", "--steps"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    events = out["events"]
+    kinds = [e["kind"] for e in events]
+    story = ["guard_sdc", "guard_evict", "eviction", "elastic_regroup",
+             "epoch_complete"]
+    positions = [kinds.index(k) for k in story]
+    assert positions == sorted(positions), (
+        f"story out of order: {list(zip(story, positions))}"
+    )
+    # One replicated verdict, told once: the metrics/quarantine/dump
+    # copies of the same guard_sdc decision merged (metrics wins).
+    sdc_events = [e for e in events if e["kind"] == "guard_sdc"]
+    assert len(sdc_events) == 1 and sdc_events[0]["source"] == "metrics"
+    ev = next(e for e in events if e["kind"] == "eviction")
+    assert ev["rank"] == 2 and "sdc" in ev["detail"]["reason"]
+    # The victim's exit reason survives from its dump.
+    ex = next(e for e in events if e["kind"] == "exit")
+    assert "evicted" in ex["detail"]["reason"]
+    # Replayed steps (2, 3 ran in me0 AND me1) appear once each, from
+    # the me1 attempt; the sweep count is reported.
+    steps = sorted(e["step"] for e in events if e["kind"] == "step")
+    assert steps == [1, 2, 3, 4, 5]
+    me_of = {e["step"]: e["detail"]["me"] for e in events
+             if e["kind"] == "step"}
+    assert me_of[2] == 1 and me_of[3] == 1 and me_of[1] == 0
+    assert out["stats"]["steps"]["replayed_beats_deduped"] > 0
+    # membership sources were all found
+    assert out["stats"]["sources"]["membership"] is True
+    assert out["stats"]["sources"]["flightrec_dumps"] == 1
+
+
+def test_stragglers_leave_one_out_attribution(tmp_path, capsys):
+    obs = tmp_path / "run" / "obs"
+    obs.mkdir(parents=True)
+    now = time.time()
+    for rank in range(3):
+        for step in (1, 2, 3):
+            ms = 200.0 if (rank == 1 and step == 2) else 10.0
+            _beat(obs, rank, step, now + step, step_ms=ms)
+    rc = obsctl.main(["stragglers", str(tmp_path / "run"), "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)["stragglers"]
+    assert report[0]["world"] == 3
+    issues = report[0]["issues"]
+    assert [(i["rank"], i["step"]) for i in issues] == [(1, 2)]
+    assert issues[0]["ratio"] >= 3.0
+
+
+def test_merge_trace_synthetic_pids_per_membership_epoch(sdc_artifacts,
+                                                         tmp_path, capsys):
+    from tpu_dp.obs.export import validate_trace
+
+    out_path = tmp_path / "t.json"
+    assert obsctl.main(["merge-trace", str(sdc_artifacts), "-o",
+                        str(out_path)]) == 0
+    trace = json.loads(out_path.read_text())
+    assert validate_trace(trace) == []
+    pids = {e["pid"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    # me0 ranks 0..2 -> pids 0..2; me1 ranks 0..1 -> pids 1000..1001.
+    assert {0, 1, 2, 1000, 1001} <= pids
+    instants = {e["name"] for e in trace["traceEvents"] if e["ph"] == "i"}
+    assert "eviction" in instants and "elastic_regroup" in instants
+
+
+# -- promfile --------------------------------------------------------------
+
+def test_promfile_write_parse_roundtrip(tmp_path):
+    from tpu_dp.obs.counters import Counters
+    from tpu_dp.obs.promfile import parse_promfile, write_promfile
+
+    reg = Counters()
+    reg.inc("retry.attempts", 3)
+    reg.gauge("obs.mfu", 0.42)
+    reg.gauge("serve.device_util.b8", 0.3)
+    out = write_promfile(tmp_path / "m.prom", registry=reg,
+                         labels={"rank": "1"})
+    assert not list(tmp_path.glob("*.tmp*"))  # atomic
+    parsed = parse_promfile(out.read_text())
+    assert parsed["tpu_dp_retry_attempts"]["type"] == "counter"
+    assert parsed["tpu_dp_obs_mfu"]["type"] == "gauge"
+    (label, value), = parsed["tpu_dp_obs_mfu"]["samples"].items()
+    assert 'rank="1"' in label and value == 0.42
+    assert parsed["tpu_dp_serve_device_util_b8"]["samples"][label] == 0.3
+
+
+def test_counters_snapshot_typed_split():
+    from tpu_dp.obs.counters import Counters
+
+    reg = Counters()
+    reg.inc("a.count")
+    reg.gauge("b.gauge", 2.0)
+    counts, gauges = reg.snapshot_typed()
+    assert counts == {"a.count": 1.0} and gauges == {"b.gauge": 2.0}
+    # The flat snapshot stays the union (back-compat).
+    assert reg.snapshot() == {"a.count": 1.0, "b.gauge": 2.0}

@@ -85,12 +85,19 @@ def main() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = str(s.getsockname()[1])
-    tmp = Path(tempfile.mkdtemp(prefix="tpu_dp_elastic_smoke."))
+    import os
+
+    # TPU_DP_SMOKE_DIR pins the run dir so a downstream consumer (the
+    # --obsctl tier-1 lane runs `obsctl timeline` over this very run's
+    # artifacts) can find it; default stays a throwaway tempdir.
+    keep = os.environ.get("TPU_DP_SMOKE_DIR")
+    tmp = (Path(keep) if keep
+           else Path(tempfile.mkdtemp(prefix="tpu_dp_elastic_smoke.")))
+    tmp.mkdir(parents=True, exist_ok=True)
     script = tmp / "worker.py"
     script.write_text(_WORKER)
     ckpt = tmp / "ck"
     outs = [tmp / f"out{r}.pkl" for r in range(3)]
-    import os
 
     env = dict(os.environ, PYTHONPATH=str(REPO))
     env.pop("TPU_DP_FAULT", None)
@@ -173,7 +180,8 @@ def main() -> int:
         for i, log in enumerate(logs):
             print(f"--- rank {i}\n{log[-2000:]}", file=sys.stderr)
         return 1
-    shutil.rmtree(tmp, ignore_errors=True)
+    if not keep:  # a pinned dir belongs to the caller (the obsctl lane)
+        shutil.rmtree(tmp, ignore_errors=True)
     return 0
 
 

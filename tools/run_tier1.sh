@@ -43,6 +43,24 @@
 #                                    # quarantine.jsonl + artifacts/
 #                                    # guard_report.json, then the
 #                                    # -m guard tests.
+#   tools/run_tier1.sh --obsctl      # forensic-tooling lane: runs the
+#                                    # guard spike-rollback smoke (at
+#                                    # obs=full, so flight-recorder dumps,
+#                                    # schema-3 efficiency records and
+#                                    # rollback generations all land) and
+#                                    # the elastic kill-one-rank smoke,
+#                                    # then drives `obsctl` over nothing
+#                                    # but their artifact directories:
+#                                    # timeline (exit-coded, archived),
+#                                    # merge-trace (validated Perfetto),
+#                                    # and diff (clean run vs its own
+#                                    # baseline must exit 0; a tampered
+#                                    # baseline must exit 1 — the CI gate
+#                                    # proof). Archives artifacts/
+#                                    # obsctl_report.json + the timeline
+#                                    # and merged trace, then the -m obs
+#                                    # tests (which now cover flightrec /
+#                                    # costs / promfile / obsctl).
 #   tools/run_tier1.sh --serve       # serving lane: a 200-request mixed-
 #                                    # size synthetic load through the full
 #                                    # queue → batcher → compiled-forward
@@ -166,6 +184,81 @@ PY
     rm -rf "$SMOKE"
     echo "guard smoke: artifacts/quarantine.jsonl + artifacts/guard_report.json"
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m guard \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--obsctl" ]; then
+    mkdir -p artifacts
+    SMOKE=$(mktemp -d /tmp/tpu_dp_obsctl_smoke.XXXXXX) || exit 1
+    # 1. The guard spike-rollback smoke, at obs=full: real rollback
+    #    generations in metrics/quarantine/heartbeats + a black box.
+    env JAX_PLATFORMS=cpu python train.py \
+        --data.dataset=synthetic --data.synthetic_train_size=128 \
+        --data.synthetic_test_size=16 --data.batch_size=4 \
+        --train.epochs=2 --train.log_every=100 --train.eval_at_end=false \
+        --train.steps_per_call=1 --parallel.num_devices=1 \
+        --train.ckpt_dir="$SMOKE/roll" --train.ckpt_async=false \
+        --train.obs=full \
+        --resilience.snapshot_every_steps=5 \
+        --guard.enabled=true --guard.action=rollback \
+        --guard.spike_min_steps=4 --guard.spike_z=12 \
+        --resilience.fault=spike:step=8,scale=1e6 \
+        > "$SMOKE/roll.out" || exit $?
+    # 2. The elastic kill-one-rank smoke, run dir pinned for obsctl.
+    env JAX_PLATFORMS=cpu TPU_DP_SMOKE_DIR="$SMOKE/elastic" \
+        python tools/elastic_smoke.py || exit $?
+    # 3. obsctl over nothing but the artifact directories.
+    env JAX_PLATFORMS=cpu python -m tpu_dp.obs timeline "$SMOKE/roll" \
+        --json --steps > artifacts/obsctl_timeline.json || exit $?
+    env JAX_PLATFORMS=cpu python -m tpu_dp.obs timeline \
+        "$SMOKE/elastic/ck" --json --steps \
+        > artifacts/obsctl_timeline_elastic.json || exit $?
+    env JAX_PLATFORMS=cpu python -m tpu_dp.obs merge-trace "$SMOKE/roll" \
+        -o artifacts/obsctl_trace.json || exit $?
+    env JAX_PLATFORMS=cpu python -m tpu_dp.obs diff "$SMOKE/roll" \
+        --write-baseline "$SMOKE/base.json" || exit $?
+    env JAX_PLATFORMS=cpu python -m tpu_dp.obs diff "$SMOKE/roll" \
+        --baseline "$SMOKE/base.json" --json \
+        > "$SMOKE/diff_clean.json" || exit $?
+    # The gate must also TRIP: a tampered baseline (10x tighter p95)
+    # has to exit nonzero, or the diff is a rubber stamp.
+    env JAX_PLATFORMS=cpu python - "$SMOKE" <<'PY' || exit 1
+import json, subprocess, sys
+from pathlib import Path
+smoke = Path(sys.argv[1])
+base = json.loads((smoke / "base.json").read_text())
+assert base["goodput"] is not None and base["p95_ms"] is not None, base
+tampered = dict(base, p95_ms=base["p95_ms"] / 10.0)
+(smoke / "tampered.json").write_text(json.dumps(tampered))
+rc = subprocess.run(
+    [sys.executable, "-m", "tpu_dp.obs", "diff", str(smoke / "roll"),
+     "--baseline", str(smoke / "tampered.json")],
+    capture_output=True, text=True,
+).returncode
+assert rc == 1, f"tampered baseline must exit 1, got {rc}"
+timeline = json.loads(Path("artifacts/obsctl_timeline.json").read_text())
+kinds = [e["kind"] for e in timeline["events"]]
+assert "guard_rollback" in kinds and "exit" in kinds, kinds[:20]
+steps = [e["step"] for e in timeline["events"] if e["kind"] == "step"]
+assert len(steps) == len(set(steps)), "duplicate replayed-step events"
+el = json.loads(Path("artifacts/obsctl_timeline_elastic.json").read_text())
+el_kinds = [e["kind"] for e in el["events"]]
+assert "eviction" in el_kinds and "elastic_regroup" in el_kinds, el_kinds[:20]
+report = {
+    "ok": True,
+    "rollback_timeline_events": len(kinds),
+    "elastic_timeline_events": len(el_kinds),
+    "distinct_steps": timeline["stats"]["steps"],
+    "diff_clean": json.loads((smoke / "diff_clean.json").read_text()),
+    "diff_tampered_exit": rc,
+}
+Path("artifacts/obsctl_report.json").write_text(
+    json.dumps(report, indent=2) + "\n")
+print("obsctl lane:", json.dumps(report)[:300])
+PY
+    rm -rf "$SMOKE"
+    echo "obsctl lane: artifacts/obsctl_report.json + obsctl_timeline*.json + obsctl_trace.json"
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs \
         -p no:cacheprovider
 fi
 

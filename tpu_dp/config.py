@@ -151,6 +151,25 @@ class ObsConfig:
     # Perfetto trace output ("" = <run_dir>/trace.perfetto.json), written
     # by rank 0 at the end of fit().
     perfetto_path: str = ""
+    # Flight recorder (tpu_dp/obs/flightrec.py): ring size of the always-on
+    # structured-event black box, dumped to <run_dir>/flightrec_r<rank>.json
+    # on every fit() exit path (clean, preempted, diverged, crashed) and on
+    # a hang-dump request. 0 disables recording AND dumps. Independent of
+    # train.obs — crash forensics must not require live telemetry on.
+    flightrec_capacity: int = 2048
+    # Prometheus text-format exporter ("" = off): the counter registry is
+    # atomically rewritten to this path at log boundaries, epoch ends and
+    # exit — a node scraper (textfile collector) picks it up; no HTTP
+    # server. Multi-process runs suffix the file with .r<rank>.
+    prom_path: str = ""
+    # Peak FLOP/s override for MFU (0 = derive from the device kind via
+    # tpu_dp.obs.costs.peak_flops; unknown kinds publish no MFU). Lets CPU
+    # smokes and exotic chips get a defined utilization denominator.
+    peak_flops_override: float = 0.0
+    # AOT-compile the train step once at startup and register its XLA
+    # cost-analysis FLOPs in the cost registry (exact MFU for any model,
+    # at one extra compile); off = analytic per-model estimates only.
+    measure_flops: bool = False
 
 
 @dataclass

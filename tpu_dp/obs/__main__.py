@@ -1,0 +1,8 @@
+"""``python -m tpu_dp.obs`` — the obsctl forensic CLI (see obsctl.py)."""
+
+import sys
+
+from tpu_dp.obs.obsctl import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,6 +70,15 @@ class Counters:
                 out[k] = round(v, 6)
         return out
 
+    def snapshot_typed(self) -> tuple[dict[str, float], dict[str, float]]:
+        """(counters, gauges) as two dicts — the Prometheus exporter needs
+        the type split (`# TYPE ... counter|gauge`) that the flat
+        `snapshot` deliberately erases."""
+        return (
+            {k: round(v, 6) for k, v in list(self._counts.items())},
+            {k: round(v, 6) for k, v in list(self._gauges.items())},
+        )
+
     def reset(self) -> None:
         """Drop everything — test isolation only."""
         self._counts.clear()

@@ -29,14 +29,21 @@ from typing import Any, Mapping, Sequence
 
 from tpu_dp.obs.spans import STEP_SPANS
 
-#: tid 0..len-1 are span tracks; the counters track sits after them.
-_COUNTER_TID_OFFSET = 64
+#: tids [gen * stride, (gen+1) * stride) are rollback-generation ``gen``'s
+#: span tracks: each generation renders as its own track group, so a
+#: post-rollback replay of step K sits on separate tracks from the
+#: rolled-back attempt instead of overdrawing it.
+_GEN_TID_STRIDE = 32
+#: the counters track sits far above any plausible generation block.
+_COUNTER_TID_OFFSET = 10**6
 
 
-def _span_tid(name: str, order: dict[str, int]) -> int:
-    if name not in order:
-        order[name] = len(order)
-    return order[name]
+def _span_tid(name: str, gen: int, order: dict[tuple[int, str], int]) -> int:
+    key = (gen, name)
+    if key not in order:
+        in_gen = sum(1 for g, _ in order if g == gen)
+        order[key] = gen * _GEN_TID_STRIDE + in_gen
+    return order[key]
 
 
 def to_trace_events(
@@ -54,7 +61,9 @@ def to_trace_events(
     """
     rank = int(rank)
     events: list[dict] = []
-    tid_order: dict[str, int] = {name: i for i, name in enumerate(STEP_SPANS)}
+    tid_order: dict[tuple[int, str], int] = {
+        (0, name): i for i, name in enumerate(STEP_SPANS)
+    }
     events.append({
         "name": "process_name", "ph": "M", "pid": rank, "tid": 0,
         "args": {"name": process_name or f"tpu_dp rank {rank}"},
@@ -62,6 +71,12 @@ def to_trace_events(
     for rec in records:
         t_us = float(rec["ts"]) * 1e6
         spans = rec["spans"]
+        # Each rollback generation gets its OWN track group (tid block):
+        # a post-rollback trace previously interleaved two attempts at the
+        # same step index on one track, which rendered as overlapping
+        # slices — now the replay sits under "<span> [gen N]" threads and
+        # the rolled-back attempt stays legible next to it.
+        gen = int(rec.get("gen", 0))
         # Slices go out in the recorder's span order, laid back-to-back —
         # the loop measures them sequentially, so the timeline is honest.
         ordered = [n for n in STEP_SPANS if n in spans] + [
@@ -69,21 +84,24 @@ def to_trace_events(
         ]
         for name in ordered:
             dur_us = max(0.0, float(spans[name]) * 1e3)  # ms → µs
-            events.append({
+            ev = {
                 "name": name,
                 "cat": "step",
                 "ph": "X",
                 "ts": round(t_us, 3),
                 "dur": round(dur_us, 3),
                 "pid": rank,
-                "tid": _span_tid(name, tid_order),
+                "tid": _span_tid(name, gen, tid_order),
                 "args": {"step": int(rec["step"])},
-            })
+            }
+            if gen:
+                ev["args"]["gen"] = gen
+            events.append(ev)
             t_us += dur_us
-    for name, tid in sorted(tid_order.items(), key=lambda kv: kv[1]):
+    for (gen, name), tid in sorted(tid_order.items(), key=lambda kv: kv[1]):
         events.append({
             "name": "thread_name", "ph": "M", "pid": rank, "tid": tid,
-            "args": {"name": name},
+            "args": {"name": name if not gen else f"{name} [gen {gen}]"},
         })
     for point in counter_points:
         t_us = round(float(point["ts"]) * 1e6, 3)
@@ -105,6 +123,37 @@ def merge_traces(traces: Sequence[Mapping[str, Any]]) -> dict:
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+def instant_event(name: str, ts_s: float, pid: int = 0,
+                  args: Mapping[str, Any] | None = None,
+                  scope: str = "g") -> dict:
+    """A Perfetto instant ("i") event — the vertical marker `obsctl
+    merge-trace` uses for evictions, rollbacks and regroups. ``scope``
+    "g" renders it across the whole timeline (vs "p" process / "t"
+    thread)."""
+    ev = {
+        "name": str(name), "ph": "i", "ts": round(float(ts_s) * 1e6, 3),
+        "pid": int(pid), "tid": 0, "s": scope,
+    }
+    if args:
+        ev["args"] = dict(args)
+    return ev
+
+
+def write_trace(path: str | os.PathLike, trace: Mapping[str, Any]) -> Path:
+    """Validate + atomically write an already-built trace object.
+
+    The shared tail of `export_perfetto` and `obsctl merge-trace`: a file
+    this module writes that Perfetto would reject is a bug here, caught
+    at write time, not in a postmortem.
+    """
+    errors = validate_trace(trace)
+    if errors:  # a malformed export is a bug in this module — fail loudly
+        raise ValueError(f"refusing to write invalid trace: {errors[:3]}")
+    from tpu_dp.obs._atomic import atomic_write_text
+
+    return atomic_write_text(path, json.dumps(trace))
+
+
 def export_perfetto(
     path: str | os.PathLike,
     records: Sequence[Mapping[str, Any]],
@@ -120,21 +169,14 @@ def export_perfetto(
     trace = to_trace_events(records, rank=rank,
                             counter_points=counter_points,
                             process_name=process_name)
-    errors = validate_trace(trace)
-    if errors:  # a malformed export is a bug in this module — fail loudly
-        raise ValueError(f"refusing to write invalid trace: {errors[:3]}")
-    out = Path(path)
-    out.parent.mkdir(parents=True, exist_ok=True)
-    tmp = out.with_name(out.name + ".tmp")
-    tmp.write_text(json.dumps(trace), encoding="utf-8")
-    os.replace(tmp, out)
-    return out
+    return write_trace(path, trace)
 
 
 _REQUIRED_BY_PH = {
     "X": ("name", "ts", "dur", "pid", "tid"),
     "M": ("name", "pid", "args"),
     "C": ("name", "ts", "pid", "args"),
+    "i": ("name", "ts", "pid"),
 }
 
 

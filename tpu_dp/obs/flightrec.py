@@ -1,0 +1,270 @@
+"""Flight recorder: a bounded ring of structured events that survives death.
+
+The telemetry built so far measures a *healthy* run; when a run dies, the
+spans/counters that explain *why* die with the process (the Perfetto
+export runs in `fit()`'s finally, but only rank 0 writes it and only the
+span ring lands there). The flight recorder is the black box: every
+subsystem appends cheap structured events — step ends, guard verdicts,
+snapshot/rollback/regroup transitions, preemption signals, serve
+dispatches — into a bounded ring, and the ring is dumped ATOMICALLY to
+``flightrec_r<rank>.json`` on every exit path out of `Trainer.fit`
+(clean, `PreemptedError`, `DivergedError`, `PeerFailedError`,
+`HealthError`, unhandled exceptions, and SIGTERM via the preemption
+handler's boundary raise), so a dead rank always leaves an ordered,
+timestamped account of its last ``capacity`` decisions.
+`python -m tpu_dp.obs timeline` merges the per-rank dumps with the
+metrics/quarantine/membership artifacts into one forensic timeline
+(docs/OBSERVABILITY.md "Flight recorder").
+
+Design constraints, in the counters mold (`tpu_dp/obs/counters.py`):
+
+- **Always-on and allocation-light**: `record` is one dict build + one
+  deque append under the GIL — no locks (safe from signal handlers: the
+  preemption handler records), no jax, no IO. Subsystems publish
+  unconditionally; what gates anything is whether a dump directory was
+  `configure`d (the Trainer does; a bare library user gets an in-memory
+  ring they can `dump()` themselves).
+- **Rank-stable filenames**: the dump name uses the rank given at
+  `configure` time — the Trainer passes its *stable* launch rank, so an
+  elastic regroup's dense-rank reassignment can never make two processes
+  overwrite each other's black box.
+- **Atomic dumps**: tmp + rename, like the Perfetto export — a dump
+  raced by the dying process's teardown must never leave half a JSON
+  where the postmortem expects evidence.
+
+Hang dumps: a hung rank never reaches an exit path, so rank 0's
+`HealthMonitor` (which flags the stale heartbeat) drops a
+``dump_request.json`` sentinel into the shared obs dir
+(`HealthMonitor.request_dump`); every still-stepping rank polls the
+sentinel at window boundaries (`FlightRecorderHook`) and dumps its ring
+mid-run — the survivors' view of the minutes before the hang is exactly
+what the postmortem needs when the hung rank's own ring is unreachable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any
+
+from tpu_dp.obs._atomic import atomic_write_text
+
+#: Dump-file schema version (bumped on any breaking layout change; obsctl
+#: refuses schemas it does not know rather than misreading them).
+SCHEMA = 1
+
+
+def _json_default(value):
+    """Tolerant JSON fallback: recorded fields arrive from every
+    subsystem, numpy scalars included (SDC verdicts, device metrics) — a
+    black box that refuses to serialize on a dying exit path would be
+    worse than a lossy repr. Float-first (int() would truncate a numpy
+    float), narrowed back to int when exact."""
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return repr(value)
+    i = int(f)
+    return i if i == f else f
+
+#: dump filename pattern (rank is the stable launch rank, zero-padded
+#: like the heartbeat files so shell globs sort them).
+DUMP_GLOB = "flightrec_r*.json"
+
+#: the hang-dump sentinel rank 0's HealthMonitor drops into the obs dir.
+DUMP_REQUEST = "dump_request.json"
+
+
+def dump_path_for(dump_dir: str | os.PathLike, rank: int) -> Path:
+    return Path(dump_dir) / f"flightrec_r{int(rank):05d}.json"
+
+
+class FlightRecorder:
+    """A bounded ring of ``{"ts", "kind", ...}`` events with atomic dumps."""
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = max(1, int(capacity))
+        self._events: deque[dict] = deque(maxlen=self.capacity)
+        self.total_recorded = 0   # lifetime count, beyond the ring
+        self.rank = 0
+        self.dump_dir: Path | None = None
+        self.run: dict[str, Any] = {}
+        self.dumps = 0
+        self.enabled = True       # disable() makes record() a no-op
+        self._req_handled = 0.0   # mtime of the last honored dump request
+
+    def configure(self, rank: int = 0,
+                  dump_dir: str | os.PathLike | None = None,
+                  capacity: int | None = None,
+                  run: dict | None = None,
+                  fresh: bool = False) -> "FlightRecorder":
+        """Set identity + dump target (the Trainer calls this at startup).
+
+        ``fresh=True`` marks a RUN boundary: the ring is cleared so a new
+        Trainer in a long-lived process (tests, notebooks) never dumps a
+        previous run's events as its own. Plain reconfiguration keeps the
+        contents — an elastic regroup re-homes the observers mid-run, and
+        the pre-regroup events are exactly the forensics a later dump
+        must carry. ``capacity`` changes rebuild the ring (contents
+        preserved up to the new bound).
+        """
+        if fresh:
+            self._events.clear()
+            self.total_recorded = 0
+            self.dumps = 0
+            self._req_handled = 0.0
+        self.enabled = True
+        self.rank = int(rank)
+        self.dump_dir = None if dump_dir is None else Path(dump_dir)
+        if run is not None:
+            self.run = dict(run)
+        if capacity is not None and int(capacity) != self.capacity:
+            self.capacity = max(1, int(capacity))
+            self._events = deque(self._events, maxlen=self.capacity)
+        return self
+
+    def disable(self) -> "FlightRecorder":
+        """Stop recording entirely (``obs.flightrec_capacity=0``): every
+        module-level `record()` call across the codebase becomes a no-op
+        — "disabled" must mean no events accumulate, not merely no dump.
+        The ring is cleared so a later `dump()` cannot resurrect a
+        disabled run's history. Re-enabled by the next `configure`."""
+        self.enabled = False
+        self._events.clear()
+        self.total_recorded = 0
+        self.dump_dir = None
+        return self
+
+    def record(self, kind: str, step: int | None = None,
+               **fields: Any) -> dict:
+        """Append one event; safe from signal handlers (no locks, no IO).
+        A disabled recorder returns the built event without storing it."""
+        ev: dict[str, Any] = {"ts": time.time(), "kind": str(kind)}
+        if step is not None:
+            ev["step"] = int(step)
+        if fields:
+            ev.update(fields)
+        if self.enabled:
+            self._events.append(ev)
+            self.total_recorded += 1
+        return ev
+
+    def events(self) -> list[dict]:
+        """The ring's contents, oldest first."""
+        return list(self._events)
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    # -- dumping --------------------------------------------------------
+
+    def dump(self, path: str | os.PathLike | None = None,
+             reason: str = "unspecified",
+             extra: dict | None = None) -> Path | None:
+        """Write the ring (+ a counter snapshot) atomically; returns the
+        path, or None when neither ``path`` nor a configured dump dir
+        names one. Never raises: the dump runs on dying exit paths where
+        a telemetry failure must not mask the original error — a failed
+        dump logs and returns None.
+        """
+        if path is None:
+            if self.dump_dir is None:
+                return None
+            path = dump_path_for(self.dump_dir, self.rank)
+        out = Path(path)
+        try:
+            from tpu_dp.obs.counters import counters
+
+            payload = {
+                "schema": SCHEMA,
+                "rank": self.rank,
+                "reason": str(reason),
+                "ts": time.time(),
+                "run": self.run,
+                "total_recorded": self.total_recorded,
+                "counters": counters.snapshot(),
+                "events": list(self._events),
+            }
+            if extra:
+                payload.update(extra)
+            atomic_write_text(out, json.dumps(payload,
+                                              default=_json_default))
+            self.dumps += 1
+            return out
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "flight-recorder dump to %s failed", out, exc_info=True
+            )
+            return None
+
+    # -- hang-dump sentinel --------------------------------------------
+
+    def poll_dump_request(self) -> Path | None:
+        """Honor a pending ``dump_request.json`` in the dump dir (once per
+        sentinel write): dump the ring mid-run and return the dump path.
+        Called at window boundaries by `FlightRecorderHook` — one stat()
+        per dispatched window when configured, nothing otherwise.
+        """
+        if self.dump_dir is None:
+            return None
+        req = self.dump_dir / DUMP_REQUEST
+        try:
+            mtime = req.stat().st_mtime
+        except OSError:
+            return None
+        if mtime <= self._req_handled:
+            return None
+        self._req_handled = mtime
+        try:
+            why = json.loads(req.read_text()).get("reason", "requested")
+        except (OSError, ValueError):
+            why = "requested"
+        return self.dump(reason=f"dump_request: {why}")
+
+    def reset(self) -> None:
+        """Drop everything — test isolation only."""
+        self._events.clear()
+        self.total_recorded = 0
+        self.dumps = 0
+        self.enabled = True
+        self._req_handled = 0.0
+        self.run = {}
+        self.dump_dir = None
+        self.rank = 0
+
+
+#: The process-wide recorder every subsystem publishes into.
+recorder = FlightRecorder()
+
+
+def record(kind: str, step: int | None = None, **fields: Any) -> dict:
+    """Module-level shorthand: `recorder.record(...)`."""
+    return recorder.record(kind, step=step, **fields)
+
+
+def write_dump_request(run_dir: str | os.PathLike, reason: str) -> Path:
+    """Drop the hang-dump sentinel (rank 0 / an out-of-band watcher).
+
+    Overwrites any previous sentinel: the stepping ranks honor each
+    distinct mtime once, so repeated requests produce repeated dumps.
+    """
+    return atomic_write_text(
+        Path(run_dir) / DUMP_REQUEST,
+        json.dumps({"reason": str(reason), "ts": time.time()}),
+    )
+
+
+def read_dump(path: str | os.PathLike) -> dict:
+    """Load + schema-check one dump file (obsctl / tests)."""
+    payload = json.loads(Path(path).read_text(encoding="utf-8"))
+    if payload.get("schema") != SCHEMA:
+        raise ValueError(
+            f"flight-recorder dump {path} has schema "
+            f"{payload.get('schema')!r}, expected {SCHEMA}"
+        )
+    return payload

@@ -351,6 +351,35 @@ class HealthMonitor:
             issues.extend(self._straggler_issues(by_step[step]))
         return issues
 
+    # -- hang forensics ------------------------------------------------
+
+    def request_dump(self, issues: list[HealthIssue],
+                     dump_dir: str | os.PathLike | None = None
+                     ) -> os.PathLike | None:
+        """Drop the flight-recorder hang-dump sentinel when ``issues``
+        name a stale/missing rank (docs/OBSERVABILITY.md "Flight
+        recorder"): a hung rank never reaches an exit path, so its own
+        ring is unreachable — the sentinel makes every still-stepping
+        rank dump ITS ring at the next window boundary, preserving the
+        survivors' view of the minutes before the hang. Stragglers are
+        slow, not dead — they never trigger a dump.
+
+        ``dump_dir`` must be the directory the recorders POLL (the
+        trainer passes its flight recorder's dump dir — the launch obs
+        root, which after an elastic regroup is NOT this monitor's
+        re-homed ``me<E>`` run dir). Defaults to ``run_dir`` for
+        monitors watching the launch topology. Returns the sentinel path
+        when one was written."""
+        hung = [i for i in issues if i.kind in ("stale", "missing")]
+        if not hung:
+            return None
+        from tpu_dp.obs import flightrec
+
+        reason = "; ".join(i.describe() for i in hung)
+        return flightrec.write_dump_request(
+            self.run_dir if dump_dir is None else dump_dir, reason
+        )
+
     # -- reporting -----------------------------------------------------
 
     def report(self, issues: list[HealthIssue]) -> list[HealthIssue]:

@@ -1,0 +1,710 @@
+"""obsctl — one forensic timeline out of every per-rank run artifact.
+
+A dead run leaves its story scattered across disjoint files: rank-0's
+``metrics.jsonl`` (schema-3 records + guard/elastic events), the
+guardrail ``quarantine.jsonl``, per-rank-per-membership-epoch heartbeat
+files, per-rank flight-recorder dumps, and the elastic membership
+ledger. Each is internally consistent; none alone answers "what
+happened". ``obsctl`` merges them — generation-aware on both axes
+(guard rollback generations AND elastic membership epochs), so replayed
+work never double-counts — into:
+
+- ``timeline``    — the ordered, deduplicated event stream (divergence
+  detected → rank attributed → eviction → rollback resume → completion,
+  reconstructed from the artifacts directory alone);
+- ``stragglers``  — post-hoc leave-one-out straggler attribution over
+  every heartbeat dir (`HealthMonitor.scan`);
+- ``merge-trace`` — one Perfetto file spanning ranks AND regroup
+  generations, with evictions/rollbacks/regroups as instant-event
+  markers;
+- ``diff``        — a regression verdict of the run's mfu / goodput /
+  p95 step time against a ``BENCH_*.json`` baseline, exit-coded so CI
+  can gate on it (``--write-baseline`` mints a baseline from a run).
+
+Run it as ``python -m tpu_dp.obs <cmd> <run_dir>`` or
+``tools/obsctl.py``; ``run_dir`` is the training run's checkpoint root
+(the tree that holds ``metrics.jsonl``, ``quarantine.jsonl``, ``obs/``,
+``membership/``). Needs no accelerator and dispatches nothing to a
+device: postmortems run in watcher processes.
+
+Exit codes: 0 clean, 1 regression (``diff`` only), 2 usage/artifact
+error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from datetime import datetime, timezone
+from pathlib import Path
+
+from tpu_dp.obs import flightrec
+from tpu_dp.obs.health import HealthMonitor
+from tpu_dp.obs.spans import percentile
+
+#: quarantine-log kinds → the metrics-stream event names, so the same
+#: finding arriving via both files deduplicates instead of double-telling.
+_QUARANTINE_KINDS = {
+    "sdc": "guard_sdc",
+    "spike": "guard_spike",
+    "quarantine": "guard_quarantine",
+    "tombstone": "guard_tombstone",
+}
+
+#: event kinds rendered as instant markers in ``merge-trace``.
+MARKER_KINDS = (
+    "guard_sdc", "guard_spike", "guard_quarantine", "guard_tombstone",
+    "guard_trigger", "guard_rollback", "guard_halt", "eviction",
+    "membership_epoch", "elastic_regroup", "elastic_departure",
+    "preempt_signal", "preempt_exit", "dump_request", "exit",
+)
+
+#: Event kinds describing one REPLICATED decision that reaches the
+#: timeline through several artifacts — the metrics stream, the
+#: quarantine log, and every rank's flight recorder all record the same
+#: verdict at the same step. Deduped on (kind, step); the first source
+#: processed (metrics, which carries the richest detail) wins. Kinds NOT
+#: listed are inherently per-rank facts (exits, evictions, departures,
+#: preemption signals, serve dispatches) and are never merged away.
+_REPLICATED_KINDS = frozenset({
+    "guard_sdc", "guard_spike", "guard_quarantine", "guard_tombstone",
+    "guard_trigger", "guard_halt", "guard_rollback",
+    "elastic_trigger", "elastic_regroup", "epoch_start", "snapshot",
+})
+
+_ME_DIR_RE = re.compile(r"^me(\d+)$")
+
+
+# --------------------------------------------------------------------------
+# artifact discovery + loading
+# --------------------------------------------------------------------------
+
+def _parse_ts(value) -> float | None:
+    """Epoch seconds from a float or an ISO-8601 string (or None)."""
+    if value is None:
+        return None
+    if isinstance(value, (int, float)):
+        return float(value)
+    try:
+        dt = datetime.fromisoformat(str(value))
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=timezone.utc)
+        return dt.timestamp()
+    except ValueError:
+        return None
+
+
+def _iso(ts: float) -> str:
+    return datetime.fromtimestamp(ts, timezone.utc).isoformat(
+        timespec="milliseconds"
+    )
+
+
+def _read_jsonl(path: Path) -> list[dict]:
+    """Tolerant JSONL reader: torn lines (a record written while the host
+    died) are expected in forensic inputs, not an error."""
+    if not path.exists():
+        return []
+    out = []
+    for line in path.read_text(encoding="utf-8").splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+class RunArtifacts:
+    """Everything obsctl can find under one run directory."""
+
+    def __init__(self, run_dir: str | Path,
+                 metrics_path: str | Path | None = None):
+        self.run_dir = Path(run_dir)
+        if not self.run_dir.exists():
+            raise FileNotFoundError(f"run dir {self.run_dir} does not exist")
+        self.metrics_path = (
+            Path(metrics_path) if metrics_path
+            else self.run_dir / "metrics.jsonl"
+        )
+        self.obs_dir = self.run_dir / "obs"
+        self.quarantine_path = self.run_dir / "quarantine.jsonl"
+        self.membership_dir = self.run_dir / "membership"
+
+    def metrics(self) -> list[dict]:
+        return _read_jsonl(self.metrics_path)
+
+    def quarantine(self) -> list[dict]:
+        return _read_jsonl(self.quarantine_path)
+
+    def heartbeat_dirs(self) -> list[tuple[int, Path]]:
+        """(membership_epoch, dir) pairs holding heartbeat files; epoch 0
+        is the launch topology's ``obs/`` root, ``obs/me<E>/`` the
+        post-regroup re-homes (`Trainer._rebuild_observers`)."""
+        out: list[tuple[int, Path]] = []
+        roots = [self.obs_dir] if self.obs_dir.is_dir() else []
+        # the run dir itself may BE the obs dir (bare heartbeat trees)
+        if not roots and any(self.run_dir.glob("heartbeat_r*.jsonl")):
+            roots = [self.run_dir]
+        for root in roots:
+            if any(root.glob("heartbeat_r*.jsonl")):
+                out.append((0, root))
+            for child in sorted(root.iterdir()):
+                m = _ME_DIR_RE.match(child.name)
+                if m and child.is_dir() and any(
+                    child.glob("heartbeat_r*.jsonl")
+                ):
+                    out.append((int(m.group(1)), child))
+        return out
+
+    def flight_dumps(self) -> list[dict]:
+        """Every readable, schema-matching flight-recorder dump."""
+        roots = [d for d in (self.obs_dir, self.run_dir) if d.is_dir()]
+        seen, dumps = set(), []
+        for root in roots:
+            for path in sorted(root.rglob(flightrec.DUMP_GLOB)):
+                if path in seen:
+                    continue
+                seen.add(path)
+                try:
+                    dumps.append(flightrec.read_dump(path))
+                except (OSError, ValueError) as e:
+                    print(f"obsctl: skipping unreadable dump {path}: {e}",
+                          file=sys.stderr)
+        return dumps
+
+    def membership_records(self) -> list[dict]:
+        """Every membership-epoch record across ledger generations."""
+        if not self.membership_dir.is_dir():
+            return []
+        out = []
+        for path in sorted(self.membership_dir.glob("*/epoch_*.json")):
+            try:
+                rec = json.loads(path.read_text())
+            except (OSError, ValueError):
+                continue
+            if isinstance(rec, dict):
+                rec["_ledger_generation"] = path.parent.name
+                out.append(rec)
+        return out
+
+
+# --------------------------------------------------------------------------
+# generation sweeps (rollback generations + membership epochs)
+# --------------------------------------------------------------------------
+
+def sweep_rollback_generations(records: list[dict]) -> list[dict]:
+    """Drop step-stamped records that a later rollback replayed over.
+
+    The reader-side twin of `tpu_dp.resilience.guard.live_records`, over
+    the *metrics* stream: a ``guard_rollback`` event retires its
+    predecessor generation at ``to_step`` — records of a retired
+    generation with ``step > to_step`` describe undone work. Event
+    records themselves (the rollback, its triggers) always survive: the
+    timeline must show that the rewind HAPPENED, only the replayed-over
+    per-step measurements are dead.
+    """
+    retired: dict[int, int] = {}
+    for rec in records:
+        if rec.get("event") == "guard_rollback":
+            gen = int(rec.get("rollback_generation", 1)) - 1
+            to_step = int(rec.get("to_step", 0))
+            retired[gen] = min(retired.get(gen, to_step), to_step)
+    out = []
+    for rec in records:
+        if "event" not in rec and "step" in rec and (
+            "epoch" not in rec
+        ):
+            gen = int(rec.get("rollback_generation", 0))
+            if gen in retired and int(rec["step"]) > retired[gen]:
+                continue
+        out.append(rec)
+    return out
+
+
+# --------------------------------------------------------------------------
+# timeline
+# --------------------------------------------------------------------------
+
+def build_timeline(art: RunArtifacts, include_steps: bool = False) -> dict:
+    """The merged, ordered, generation-deduplicated event stream.
+
+    Returns ``{"events": [...], "stats": {...}}``; each event is
+    ``{"ts", "iso", "kind", "source", ...}``. Step events (one per global
+    optimizer step, surviving attempt only) are included when
+    ``include_steps``; their coverage is always summarized in ``stats``.
+    """
+    events: list[dict] = []
+    seen: set[tuple] = set()
+
+    def add(kind: str, ts: float | None, source: str, **fields):
+        if kind in _REPLICATED_KINDS:
+            key = (kind, fields.get("step"))
+            if key in seen:
+                return
+            seen.add(key)
+        ev = {"ts": ts if ts is not None else 0.0, "kind": kind,
+              "source": source}
+        ev.update({k: v for k, v in fields.items() if v is not None})
+        events.append(ev)
+
+    # -- metrics stream (rank 0's schema-3 records) ---------------------
+    metrics = sweep_rollback_generations(art.metrics())
+    for rec in metrics:
+        ts = _parse_ts(rec.get("ts"))
+        gen = rec.get("rollback_generation")
+        if "event" in rec:
+            detail = {k: v for k, v in rec.items()
+                      if k not in ("ts", "schema", "event")}
+            add(rec["event"], ts, "metrics", step=rec.get("step"),
+                gen=gen, detail=detail)
+        elif "eval" in rec:
+            add("eval", ts, "metrics", detail=rec["eval"])
+        elif "epoch" in rec and "loss" in rec:
+            add("epoch_complete", ts, "metrics", step=rec.get("step"),
+                gen=gen,
+                detail={"epoch": rec["epoch"], "loss": rec.get("loss")})
+
+    # -- quarantine log -------------------------------------------------
+    for rec in art.quarantine():
+        kind = _QUARANTINE_KINDS.get(rec.get("kind"), rec.get("kind"))
+        detail = {k: v for k, v in rec.items() if k not in ("ts", "kind")}
+        add(kind, _parse_ts(rec.get("ts")), "quarantine",
+            step=rec.get("step"), gen=rec.get("rollback_generation"),
+            detail=detail)
+
+    # -- membership ledger ---------------------------------------------
+    for rec in art.membership_records():
+        ts = _parse_ts(rec.get("ts"))
+        epoch = rec.get("epoch")
+        if epoch == 0:
+            add("membership_formed", ts, "membership",
+                detail={"members": rec.get("members"),
+                        "world": rec.get("world")})
+            continue
+        add("membership_epoch", ts, "membership",
+            detail={"epoch": epoch, "members": rec.get("members"),
+                    "world": rec.get("world"),
+                    "reason": rec.get("reason"),
+                    "resume": rec.get("resume")})
+        for dep in rec.get("departed") or ():
+            add("eviction", ts, "membership", rank=dep.get("sid"),
+                detail={"membership_epoch": epoch,
+                        "reason": dep.get("reason")})
+
+    # -- flight-recorder dumps ------------------------------------------
+    # Dump "step" cadence events are NOT timeline step events: the
+    # heartbeat files are the canonical (generation-stamped, deduplicable)
+    # step record, and emitting both would double-tell every step. They
+    # are kept aside as a fallback for heartbeat-less runs (obs=off).
+    dumps = art.flight_dumps()
+    flight_steps: list[tuple[int | None, dict]] = []
+    for dump in dumps:
+        rank = dump.get("rank")
+        has_exit = False
+        for ev in dump.get("events", ()):
+            kind = ev.get("kind", "event")
+            if kind == "step":
+                flight_steps.append((rank, ev))
+                continue
+            has_exit = has_exit or kind == "exit"
+            detail = {k: v for k, v in ev.items()
+                      if k not in ("ts", "kind", "step")}
+            add(kind, _parse_ts(ev.get("ts")), "flightrec", rank=rank,
+                step=ev.get("step"), detail=detail or None)
+        if not has_exit:
+            # A ring that wrapped past its own exit event (or a dump taken
+            # mid-run via the hang sentinel) still yields one exit marker
+            # from the dump envelope.
+            add("exit", _parse_ts(dump.get("ts")), "flightrec", rank=rank,
+                detail={"reason": dump.get("reason"),
+                        "events_recorded": dump.get("total_recorded")})
+
+    # -- step coverage from heartbeats (surviving attempt per step) -----
+    # Replay happens on two axes: guard rollbacks (``gen`` stamps within
+    # one heartbeat file) and elastic regroups (a whole new ``me<E>``
+    # directory with reassigned dense ranks). A step's surviving attempt
+    # is the one under the highest (membership_epoch, gen) — everything
+    # below it was rewound or re-split away.
+    best: dict[int, tuple[tuple[int, int], dict]] = {}
+    beats_total = 0
+    for me_epoch, hb_dir in art.heartbeat_dirs():
+        mon = HealthMonitor(hb_dir, world=1)
+        for rank, beats in mon.read_beats().items():
+            for b in beats:
+                beats_total += 1
+                attempt = (me_epoch, int(b.get("gen", 0)))
+                cur = best.get(b["step"])
+                if cur is None or attempt >= cur[0]:
+                    best[b["step"]] = (attempt, {**b, "me": me_epoch})
+    if not best and flight_steps:
+        # Heartbeat-less run (obs=off): the black boxes' step cadence is
+        # the only coverage — same keep-highest-generation dedup.
+        for rank, ev in flight_steps:
+            beats_total += 1
+            attempt = (0, int(ev.get("gen", 0)))
+            cur = best.get(ev.get("step", -1))
+            if cur is None or attempt >= cur[0]:
+                best[ev.get("step", -1)] = (attempt, {
+                    "rank": rank, "step": ev.get("step", -1),
+                    "ts": ev.get("ts", 0.0),
+                    "step_ms": ev.get("window_ms"),
+                    "gen": ev.get("gen"), "me": 0,
+                })
+    replay_dropped = beats_total - len(best)
+    if include_steps:
+        for step, (attempt, b) in sorted(best.items()):
+            add("step", b["ts"], "heartbeat", step=step,
+                gen=b.get("gen"), rank=b.get("rank"),
+                detail={"step_ms": b.get("step_ms"), "me": b["me"]})
+
+    events.sort(key=lambda e: (e["ts"], e.get("step") or 0))
+    for ev in events:
+        ev["iso"] = _iso(ev["ts"])
+    stats = {
+        "events": len(events),
+        "sources": {
+            "metrics": art.metrics_path.exists(),
+            "quarantine": art.quarantine_path.exists(),
+            "membership": art.membership_dir.is_dir(),
+            "flightrec_dumps": len(dumps),
+            "heartbeat_dirs": len(art.heartbeat_dirs()),
+        },
+        "steps": {
+            "distinct": len(best),
+            "first": min(best) if best else None,
+            "last": max(best) if best else None,
+            "replayed_beats_deduped": replay_dropped,
+        },
+    }
+    return {"events": events, "stats": stats}
+
+
+# --------------------------------------------------------------------------
+# efficiency extraction + diff
+# --------------------------------------------------------------------------
+
+def run_efficiency(art: RunArtifacts) -> dict:
+    """The run's {mfu, goodput, p95_ms} from its metrics stream.
+
+    Prefers the epoch records' ``efficiency`` rollups (schema 3, written
+    by the live accounting); falls back to recomputing from per-step
+    span records (obs=full runs predating the rollup, or partial runs).
+    Missing signals are None — `diff` compares only what both sides have.
+    """
+    metrics = sweep_rollback_generations(art.metrics())
+    eff_recs = [r["efficiency"] for r in metrics
+                if "epoch" in r and isinstance(r.get("efficiency"), dict)]
+    if eff_recs:
+        last = eff_recs[-1]
+        return {
+            "mfu": last.get("mfu"),
+            "goodput": last.get("goodput"),
+            "p95_ms": (last.get("step_time_ms") or {}).get("p95"),
+            "source": "epoch_efficiency_rollup",
+        }
+    per_step = [r for r in metrics
+                if "spans" in r and "event" not in r and "epoch" not in r]
+    if not per_step:
+        return {"mfu": None, "goodput": None, "p95_ms": None,
+                "source": "none"}
+    totals, waits, mfus, goodputs = [], [], [], []
+    for r in per_step:
+        spans = r["spans"]
+        totals.append(sum(spans.values()))
+        waits.append(spans.get("data_wait", 0.0))
+        if r.get("mfu") is not None:
+            mfus.append(float(r["mfu"]))
+        if r.get("goodput") is not None:
+            goodputs.append(float(r["goodput"]))
+    wall = sum(totals)
+    return {
+        "mfu": round(sum(mfus) / len(mfus), 4) if mfus else None,
+        "goodput": (
+            round(sum(goodputs) / len(goodputs), 4) if goodputs
+            else (round(1.0 - sum(waits) / wall, 4) if wall > 0 else None)
+        ),
+        "p95_ms": round(percentile(sorted(totals), 95), 3),
+        "source": "per_step_spans",
+    }
+
+
+def load_baseline(path: Path) -> dict:
+    """{mfu, goodput, p95_ms} out of a BENCH_*.json (or obsctl baseline)."""
+    rec = json.loads(path.read_text())
+    latency = rec.get("latency") or {}
+    return {
+        "mfu": rec.get("mfu"),
+        "goodput": rec.get("goodput"),
+        "p95_ms": rec.get("p95_ms", latency.get("p95_ms")),
+    }
+
+
+def diff_verdict(run: dict, base: dict, tolerance: float) -> dict:
+    """Per-signal verdicts + the overall regression flag.
+
+    Lower-is-worse signals (mfu, goodput) regress below
+    ``base x (1 - tolerance)``; higher-is-worse (p95_ms) above
+    ``base x (1 + tolerance)``. Signals missing on either side are
+    reported ``skipped`` — absence of evidence is surfaced, never
+    silently passed.
+    """
+    checks = []
+    for key, worse_is_lower in (("mfu", True), ("goodput", True),
+                                ("p95_ms", False)):
+        r, b = run.get(key), base.get(key)
+        if r is None or b is None:
+            checks.append({"signal": key, "verdict": "skipped",
+                           "run": r, "baseline": b})
+            continue
+        if worse_is_lower:
+            bound = b * (1.0 - tolerance)
+            regressed = r < bound
+        else:
+            bound = b * (1.0 + tolerance)
+            regressed = r > bound
+        checks.append({
+            "signal": key, "run": r, "baseline": b,
+            "bound": round(bound, 6),
+            "verdict": "regressed" if regressed else "ok",
+        })
+    compared = [c for c in checks if c["verdict"] != "skipped"]
+    return {
+        "checks": checks,
+        "compared": len(compared),
+        "regressed": any(c["verdict"] == "regressed" for c in compared),
+        "tolerance": tolerance,
+    }
+
+
+# --------------------------------------------------------------------------
+# merge-trace
+# --------------------------------------------------------------------------
+
+def build_merged_trace(art: RunArtifacts) -> dict:
+    """One Perfetto trace across ranks AND regroup generations.
+
+    Every (membership epoch, rank) heartbeat stream becomes its own trace
+    process (``pid = me*1000 + rank`` — a reassigned dense rank after a
+    regroup is a different logical seat and must not splice into its
+    predecessor's track); rollback generations within a stream render as
+    separate track groups (`to_trace_events`' gen handling); evictions,
+    rollbacks and regroups land as global instant-event markers.
+    """
+    from tpu_dp.obs.export import instant_event, merge_traces, to_trace_events
+
+    traces = []
+    for me_epoch, hb_dir in art.heartbeat_dirs():
+        mon = HealthMonitor(hb_dir, world=1)
+        for rank, beats in sorted(mon.read_beats().items()):
+            recs = []
+            for b in beats:
+                rec = {
+                    "step": b["step"],
+                    "ts": b["ts"] - b["step_ms"] / 1e3,
+                    "spans": {"step": b["step_ms"]},
+                }
+                if b.get("gen"):
+                    rec["gen"] = int(b["gen"])
+                recs.append(rec)
+            pid = me_epoch * 1000 + rank
+            name = f"rank {rank}" + (f" (me{me_epoch})" if me_epoch else "")
+            traces.append(to_trace_events(recs, rank=pid,
+                                          process_name=name))
+    markers = []
+    for ev in build_timeline(art)["events"]:
+        if ev["kind"] in MARKER_KINDS:
+            args = {"source": ev["source"]}
+            if ev.get("rank") is not None:
+                args["rank"] = ev["rank"]
+            if ev.get("step") is not None:
+                args["step"] = ev["step"]
+            markers.append(instant_event(ev["kind"], ev["ts"], args=args))
+    return merge_traces(traces + [{"traceEvents": markers}])
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def _fmt_event(ev: dict) -> str:
+    parts = [ev["iso"], f"{ev['kind']:<20}", f"[{ev['source']}]"]
+    if ev.get("rank") is not None:
+        parts.append(f"rank={ev['rank']}")
+    if ev.get("step") is not None:
+        parts.append(f"step={ev['step']}")
+    if ev.get("gen"):
+        parts.append(f"gen={ev['gen']}")
+    detail = ev.get("detail")
+    if detail:
+        blob = json.dumps(detail, default=str)
+        parts.append(blob if len(blob) <= 160 else blob[:157] + "...")
+    return "  ".join(parts)
+
+
+def cmd_timeline(args) -> int:
+    art = RunArtifacts(args.run_dir, metrics_path=args.metrics)
+    out = build_timeline(art, include_steps=args.steps)
+    if args.json:
+        print(json.dumps(out))
+    else:
+        for ev in out["events"]:
+            print(_fmt_event(ev))
+        print(f"-- {out['stats']['events']} events; steps "
+              f"{out['stats']['steps']['first']}.."
+              f"{out['stats']['steps']['last']} "
+              f"({out['stats']['steps']['distinct']} distinct, "
+              f"{out['stats']['steps']['replayed_beats_deduped']} replayed "
+              f"beats deduped)")
+    return 0
+
+
+def cmd_stragglers(args) -> int:
+    art = RunArtifacts(args.run_dir, metrics_path=args.metrics)
+    report = []
+    for me_epoch, hb_dir in art.heartbeat_dirs():
+        world = len(list(hb_dir.glob("heartbeat_r*.jsonl")))
+        mon = HealthMonitor(hb_dir, world=world,
+                            straggler_factor=args.factor,
+                            min_step_ms=args.min_step_ms)
+        issues = mon.scan()
+        report.append({
+            "membership_epoch": me_epoch,
+            "dir": str(hb_dir),
+            "world": world,
+            "issues": [
+                {"kind": i.kind, "rank": i.rank, "step": i.step,
+                 "step_ms": i.step_ms, "median_ms": i.median_ms,
+                 "ratio": i.ratio}
+                for i in issues
+            ],
+        })
+    if args.json:
+        print(json.dumps({"stragglers": report}))
+    else:
+        if not report:
+            print("no heartbeat files found")
+        for block in report:
+            print(f"me{block['membership_epoch']} "
+                  f"(world {block['world']}, {block['dir']}):")
+            if not block["issues"]:
+                print("  no stragglers")
+            for i in block["issues"]:
+                print(f"  rank {i['rank']} at step {i['step']}: "
+                      f"{i['step_ms']:.1f} ms vs median "
+                      f"{i['median_ms']:.1f} ({i['ratio']:.1f}x)")
+    return 0
+
+
+def cmd_merge_trace(args) -> int:
+    from tpu_dp.obs.export import write_trace
+
+    art = RunArtifacts(args.run_dir, metrics_path=args.metrics)
+    trace = build_merged_trace(art)
+    if not trace["traceEvents"]:
+        print("obsctl: no heartbeat/timeline data to trace",
+              file=sys.stderr)
+        return 2
+    out = write_trace(args.out, trace)
+    print(f"merged trace: {out} ({len(trace['traceEvents'])} events) — "
+          f"open in chrome://tracing or ui.perfetto.dev")
+    return 0
+
+
+def cmd_diff(args) -> int:
+    art = RunArtifacts(args.run_dir, metrics_path=args.metrics)
+    run = run_efficiency(art)
+    if args.write_baseline:
+        payload = {
+            "metric": "obsctl_baseline",
+            "mfu": run["mfu"],
+            "goodput": run["goodput"],
+            "p95_ms": run["p95_ms"],
+            "source_run": str(art.run_dir),
+            "source": run["source"],
+        }
+        out = Path(args.write_baseline)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(payload, indent=2) + "\n")
+        print(f"baseline written: {out}")
+        return 0
+    if not args.baseline:
+        print("obsctl diff: --baseline (or --write-baseline) required",
+              file=sys.stderr)
+        return 2
+    base = load_baseline(Path(args.baseline))
+    verdict = diff_verdict(run, base, args.tolerance)
+    verdict["run_source"] = run["source"]
+    if args.json:
+        print(json.dumps(verdict))
+    else:
+        for c in verdict["checks"]:
+            print(f"{c['signal']:<8} run={c['run']} "
+                  f"baseline={c['baseline']} -> {c['verdict']}")
+    if verdict["compared"] == 0:
+        print("obsctl diff: no signal present on both sides — cannot "
+              "certify; run with train.obs=basic|full and a baseline "
+              "carrying mfu/goodput/latency.p95_ms", file=sys.stderr)
+        return 2
+    if verdict["regressed"]:
+        print("obsctl diff: REGRESSION", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dp.obs",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("run_dir", help="training run root (ckpt dir)")
+        p.add_argument("--metrics", default=None,
+                       help="metrics.jsonl path (default <run>/metrics.jsonl)")
+        p.add_argument("--json", action="store_true")
+
+    p = sub.add_parser("timeline", help="merged, ordered event stream")
+    common(p)
+    p.add_argument("--steps", action="store_true",
+                   help="include one event per (surviving) optimizer step")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("stragglers",
+                       help="post-hoc leave-one-out straggler attribution")
+    common(p)
+    p.add_argument("--factor", type=float, default=3.0)
+    p.add_argument("--min-step-ms", type=float, default=1.0)
+    p.set_defaults(fn=cmd_stragglers)
+
+    p = sub.add_parser("merge-trace",
+                       help="one Perfetto file across ranks + generations")
+    common(p)
+    p.add_argument("-o", "--out", required=True)
+    p.set_defaults(fn=cmd_merge_trace)
+
+    p = sub.add_parser("diff",
+                       help="regression verdict vs a BENCH_*.json baseline")
+    common(p)
+    p.add_argument("--baseline", default=None)
+    p.add_argument("--tolerance", type=float, default=0.1,
+                   help="relative slack before a delta is a regression")
+    p.add_argument("--write-baseline", default=None,
+                   help="mint a baseline json from this run and exit")
+    p.set_defaults(fn=cmd_diff)
+
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except FileNotFoundError as e:
+        print(f"obsctl: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,24 +70,31 @@ class SpanRecorder:
         self.total_recorded = 0  # lifetime count, beyond the ring
 
     def record(self, step: int, spans: Mapping[str, float],
-               ts: float | None = None) -> dict:
+               ts: float | None = None, gen: int = 0) -> dict:
         """Append one per-step record; ``spans`` maps name → milliseconds.
 
         ``ts`` is the step's wall-clock start (``time.time()`` seconds);
-        stamped now when omitted. Returns the stored record.
+        stamped now when omitted. ``gen`` is the rollback generation the
+        step ran under (stamped only when nonzero): the Perfetto export
+        renders each generation as its own track group, so a replayed
+        step never overdraws the attempt it rewound
+        (docs/OBSERVABILITY.md "Rollback rewind guard"). Returns the
+        stored record.
         """
         rec = {
             "step": int(step),
             "ts": time.time() if ts is None else float(ts),
             "spans": {k: float(v) for k, v in spans.items()},
         }
+        if gen:
+            rec["gen"] = int(gen)
         self._records.append(rec)
         self.total_recorded += 1
         return rec
 
     def record_window(self, first_step: int, n_steps: int,
                       spans: Mapping[str, float],
-                      ts: float | None = None) -> list[dict]:
+                      ts: float | None = None, gen: int = 0) -> list[dict]:
         """Attribute one window's span totals evenly across its steps.
 
         A window of ``n_steps`` compiled into one dispatch is observable
@@ -101,7 +108,7 @@ class SpanRecorder:
         per = {k: float(v) / n for k, v in spans.items()}
         stride_s = sum(per.values()) / 1e3
         return [
-            self.record(first_step + j, per, ts=ts0 + j * stride_s)
+            self.record(first_step + j, per, ts=ts0 + j * stride_s, gen=gen)
             for j in range(n)
         ]
 

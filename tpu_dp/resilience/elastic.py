@@ -504,6 +504,10 @@ class ElasticCoordinator:
     def mark_suspect(self, rank: int, reason: str) -> None:
         """Flag a (dense) rank of the current epoch as dead (accusation
         scoped to the next transition — see `MembershipLedger.mark_suspect`)."""
+        from tpu_dp.obs import flightrec
+
+        flightrec.record("elastic_suspect",
+                         rank=self.record.members[rank], reason=reason)
         self.ledger.mark_suspect(
             self.record.epoch + 1, self.record.members[rank], reason
         )

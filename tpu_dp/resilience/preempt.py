@@ -29,6 +29,7 @@ from pathlib import Path
 from typing import Any
 
 from tpu_dp import checkpoint as ckpt_lib
+from tpu_dp.obs import flightrec as _flightrec
 from tpu_dp.obs.counters import counters as _counters
 
 logger = logging.getLogger(__name__)
@@ -90,10 +91,14 @@ class PreemptionHandler:
     def _handle(self, signum, frame):
         self.last_signal = signum
         self._event.set()
-        # Telemetry: `Counters.inc` is lock-free by design (and imported at
-        # module scope — no import-lock in signal context), so publishing
-        # from a handler cannot deadlock (tpu_dp/obs/counters.py).
+        # Telemetry: `Counters.inc` and `flightrec.record` are lock-free
+        # by design (and imported at module scope — no import-lock in
+        # signal context), so publishing from a handler cannot deadlock
+        # (tpu_dp/obs/counters.py, tpu_dp/obs/flightrec.py). The flight
+        # recorder stamps the signal itself, so the black box shows the
+        # SIGTERM even when the process dies before the boundary raise.
         _counters.inc("preempt.signals")
+        _flightrec.record("preempt_signal", signum=int(signum))
         logger.warning(
             "preemption signal %s received — snapshotting at the next step "
             "boundary, then exiting %d",

@@ -97,6 +97,7 @@ def main(argv=None) -> int:
         engine = InferenceEngine(
             model, variables["params"],
             batch_stats=variables.get("batch_stats") or None,
+            model_name=args.model,
             **common,
         )
 

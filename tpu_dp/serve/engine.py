@@ -67,6 +67,9 @@ class InferenceEngine:
         on_retrace: str = "raise",
         fault: str = "",
         registry: Counters | None = None,
+        model_name: str = "",
+        flops_per_image: float | None = None,
+        peak_flops: float | None = None,
     ):
         import jax
 
@@ -144,6 +147,37 @@ class InferenceEngine:
         self._batch_index = 0
         self._bucket_counts: dict[int, int] = {}
         self._lock = threading.Lock()  # report() vs dispatch-thread state
+
+        # Per-bucket device-utilization accounting from the SAME cost
+        # registry the trainer's MFU gauges use (tpu_dp/obs/costs.py):
+        # forward-only FLOPs per image (analytic, ~training/3) times the
+        # bucket, per chip — world-divisible buckets shard the batch over
+        # the mesh, sub-world buckets run replicated (every chip computes
+        # the full bucket). Unknown models/chips publish nothing: absence
+        # means "not measured", never a fake number.
+        from tpu_dp.obs import costs as _costs
+
+        if flops_per_image is None and model_name:
+            flops_per_image = _costs.serve_flops_per_image(model_name)
+        self._peak = peak_flops
+        if self._peak is None:
+            try:
+                self._peak = _costs.peak_flops(
+                    jax.devices()[0].device_kind
+                )
+            except Exception:
+                self._peak = None
+        if flops_per_image:
+            world = dist.data_axis_size(self.mesh)
+            for b in self.ladder.buckets:
+                per_chip = (
+                    float(flops_per_image) * b / world
+                    if b % world == 0 else float(flops_per_image) * b
+                )
+                _costs.registry.register(
+                    f"serve_step@b{b}", per_chip,
+                    source="analytic", check="unverified",
+                )
 
     # -- programs --------------------------------------------------------
 
@@ -372,6 +406,30 @@ class InferenceEngine:
         if missed:
             self._counters.inc("serve.deadline_missed", missed)
         self._counters.gauge("serve.batch_occupancy", batch.occupancy)
+        # Per-device HBM gauges from the dispatch loop — serving was the
+        # one workload flying blind on device memory (the trainer already
+        # publishes these per window). Backends without memory stats
+        # publish nothing.
+        from tpu_dp.obs.counters import update_device_memory_gauges
+
+        update_device_memory_gauges(registry=self._counters)
+        # Per-bucket device utilization from the shared cost registry:
+        # the fraction of the chip's peak this dispatch's forward used.
+        from tpu_dp.obs import costs as _costs
+        from tpu_dp.obs import flightrec as _flightrec
+
+        util = _costs.registry.utilization(
+            f"serve_step@b{batch.bucket}", 1, device_ms / 1e3, self._peak
+        )
+        if util is not None:
+            self._counters.gauge(f"serve.device_util.b{batch.bucket}",
+                                 round(util, 4))
+            self._counters.gauge("serve.device_util", round(util, 4))
+        _flightrec.record(
+            "serve_dispatch", bucket=batch.bucket,
+            n=len(batch.requests), occupancy=batch.occupancy,
+            device_ms=round(device_ms, 3), deadline_missed=missed,
+        )
         if self._hb is not None:
             self._hb.beat(
                 step=self._batch_index,
@@ -442,6 +500,7 @@ class InferenceEngine:
             "batches": n_batches,
             "bucket_counts": buckets,
             "occupancy": snap.get("serve.batch_occupancy"),
+            "device_util": snap.get("serve.device_util"),
             "retraces": self.retraces,
             "guards": self.guard_stats(),
             "device_stats": self.device_stats(),
@@ -503,6 +562,9 @@ class InferenceEngine:
                 else 10
             )
             model = build_model(name, num_classes=num_classes)
+            # The checkpoint names the model, so the per-bucket
+            # device-utilization gauges come for free.
+            kwargs.setdefault("model_name", name)
         image_shape = kwargs.get("image_shape", (32, 32, 3))
         variables = model.init(
             jax.random.PRNGKey(0),

@@ -39,6 +39,7 @@ import dataclasses
 import time
 from typing import Any
 
+from tpu_dp.obs import flightrec as _flightrec
 from tpu_dp.obs.counters import counters as _obs_counters
 from tpu_dp.utils import log0
 
@@ -169,6 +170,54 @@ class ProfilerHook(StepHook):
     def on_step_end(self, ev: StepEvent) -> None:
         if self.tr._step_profiler is not None:
             self.tr._step_profiler.on_step(self.tr._host_step)
+
+
+class FlightRecorderHook(StepHook):
+    """The black box's feed (`tpu_dp.obs.flightrec`, docs/OBSERVABILITY.md
+    "Flight recorder").
+
+    Per window boundary it appends one cheap "step" event (no device
+    fetch — the step's wall time and the live efficiency gauges the
+    trainer already computed) and polls the hang-dump sentinel rank 0's
+    `HealthMonitor` drops when a peer's heartbeat goes stale; per
+    snapshot it records the commit. Everything heavier (guard verdicts,
+    regroup transitions, preemption) is recorded at the decision point
+    by the subsystem that decides, not here — the hook only covers the
+    cadence events no decision point owns.
+    """
+
+    def __init__(self, trainer):
+        super().__init__(trainer)
+        self._t_boundary = time.perf_counter()
+
+    def on_epoch_start(self, epoch: int) -> None:
+        self._t_boundary = time.perf_counter()
+        _flightrec.record("epoch_start", step=self.tr._host_step,
+                          epoch=epoch)
+
+    def on_step_end(self, ev: StepEvent) -> None:
+        tr = self.tr
+        now = time.perf_counter()
+        fields = {
+            "epoch": ev.epoch, "n": ev.n,
+            "window_ms": round((now - self._t_boundary) * 1e3, 3),
+        }
+        self._t_boundary = now
+        if tr._rollback_gen:
+            fields["gen"] = tr._rollback_gen
+        eff = tr._last_efficiency
+        if eff:
+            fields.update({k: eff[k] for k in ("mfu", "goodput")
+                           if k in eff})
+        _flightrec.record("step", step=tr._host_step, **fields)
+        path = _flightrec.recorder.poll_dump_request()
+        if path is not None:
+            log0("flight recorder: hang-dump request honored -> %s", path)
+
+    def on_snapshot(self, epoch: int, done: int, step: int,
+                    meta: dict[str, Any]) -> None:
+        _flightrec.record("snapshot", step=step, epoch=epoch, done=done,
+                          snapshot_kind=meta.get("kind", "snapshot"))
 
 
 class BoundaryHook(StepHook):
@@ -338,6 +387,8 @@ class GuardHook(StepHook):
     def _record_trigger(self, ev: StepEvent, t, first: int) -> None:
         tr = self.tr
         _obs_counters.inc(f"guard.{t.kind}")
+        _flightrec.record("guard_trigger", step=t.step, trigger=t.kind,
+                          action=t.action, reason=t.reason)
         log0("guard: %s (action=%s)", t.reason, t.action)
         if t.kind in ("nonfinite", "cap"):
             _obs_counters.inc("guard.quarantined")
@@ -371,6 +422,8 @@ class GuardHook(StepHook):
         tr = self.tr
         if t.action == "halt":
             _obs_counters.inc("guard.halts")
+            _flightrec.record("guard_halt", step=tr._host_step,
+                              reason=t.reason)
             raise DivergedError(f"guard halt: {t.reason}")
         if tr.elastic is not None and tr.elastic.quiescing:
             # A membership transition is converging; a local rewind now
@@ -421,6 +474,9 @@ class GuardHook(StepHook):
             return
         _obs_counters.inc("guard.sdc_mismatches")
         me = tr.ctx.process_index
+        _flightrec.record("guard_sdc", step=tr._host_step,
+                          suspects=list(verdict["suspects"]),
+                          majority=verdict["majority"])
         digest = digest_of_sums(sums)
         detail = {
             "step": tr._host_step,
@@ -463,6 +519,8 @@ class GuardHook(StepHook):
             # the newest save that predates the suspicion.
             if me in verdict["suspects"] or verdict["majority"] is None:
                 tr._guard_evict = True
+                _flightrec.record("guard_evict", step=tr._host_step,
+                                  rank=me, reason="sdc audit suspect")
                 log0("guard: this rank is the SDC suspect — leaving the "
                      "membership (rollback regroup)")
             else:

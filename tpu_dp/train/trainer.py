@@ -390,6 +390,54 @@ class Trainer:
                     min_step_ms=cfg.obs.min_step_ms,
                     on_flag=cfg.obs.on_straggler,
                 )
+        # Live efficiency accounting (tpu_dp/obs/costs.py): rolling MFU /
+        # goodput / step-time gauges per dispatched window, computed from
+        # the per-program cost registry (`_register_program_costs`). The
+        # peak-FLOPs denominator comes from the device kind (override:
+        # obs.peak_flops_override); unknown kinds publish no MFU rather
+        # than a wrong one.
+        self._eff = None
+        self._last_efficiency: dict | None = None
+        if self.obs_mode != "off":
+            from tpu_dp.obs.costs import EfficiencyMeter
+            from tpu_dp.obs.costs import peak_flops as _peak_flops
+
+            peak = cfg.obs.peak_flops_override or None
+            if peak is None:
+                try:
+                    peak = _peak_flops(jax.devices()[0].device_kind)
+                except Exception:
+                    peak = None
+            self._eff = EfficiencyMeter(peak=peak,
+                                        capacity=cfg.obs.span_capacity)
+        # Flight recorder (tpu_dp/obs/flightrec.py): the always-on black
+        # box, independent of train.obs — crash forensics must not require
+        # live telemetry. The dump filename uses the STABLE launch rank so
+        # an elastic regroup's dense-rank reassignment can never make two
+        # processes overwrite each other's dump; the dump dir stays the
+        # launch obs root for the same reason (obsctl globs it).
+        self.flightrec = None
+        from tpu_dp.obs import flightrec as _flightrec
+
+        if cfg.obs.flightrec_capacity <= 0:
+            # "Disabled" must mean disabled: the subsystems' module-level
+            # record() calls become no-ops, not silent in-memory growth.
+            _flightrec.recorder.disable()
+        else:
+            self.flightrec = _flightrec.recorder.configure(
+                rank=self.stable_rank, dump_dir=self.obs_dir,
+                capacity=cfg.obs.flightrec_capacity,
+                fresh=True,  # a new Trainer is a new run's black box
+                run={
+                    "model": cfg.model.name,
+                    "world": self.ctx.process_count,
+                    "devices": self.num_devices,
+                    "global_batch": self.global_batch_size,
+                    "elastic": bool(cfg.resilience.elastic),
+                    "guard": self.guard_enabled,
+                },
+            )
+        self._prom_failed = False  # one-shot prom-write failure warning
         # Step-ranged profiling (train.profile_steps=START:END): trace only
         # the window under investigation instead of the whole run.
         profile_range = parse_profile_steps(cfg.train.profile_steps)
@@ -407,6 +455,11 @@ class Trainer:
         self._rollback_gen = 0
         self._guard_evict = False
         self._sdc_suspect_active = False  # suppresses snapshots (hooks.py)
+
+        # Per-program FLOP costs for the live MFU gauges (and bench's
+        # single source of truth) — registered after state creation so the
+        # optional measured path can AOT-compile the real step.
+        self._register_program_costs()
 
         # The step-lifecycle hook registry (tpu_dp/train/hooks.py): every
         # cross-cutting subsystem — guardrails, snapshots, fault injection,
@@ -582,8 +635,18 @@ class Trainer:
             SnapshotHook,
         )
 
+        from tpu_dp.train.hooks import FlightRecorderHook
+
         self._guard_hook = GuardHook(self) if self.guard_enabled else None
         hooks: list = []
+        if self.flightrec is not None:
+            # FIRST, before anything that can raise: the black box must
+            # record the very boundary a guard halt / regroup / preempt
+            # is about to raise out of — later hooks in a sweep are
+            # skipped after a raise, and the fatal window is exactly the
+            # one the postmortem needs. (The guard-before-snapshot
+            # invariant below is untouched: this hook snapshots nothing.)
+            hooks.append(FlightRecorderHook(self))
         if self._guard_hook is not None:
             hooks.append(self._guard_hook)
         hooks += [SnapshotHook(self), FaultHook(self), HeartbeatHook(self),
@@ -698,9 +761,18 @@ class Trainer:
         stale binary / different JAX build / diverged config fails here
         instead of deadlocking the slice at the first divergent collective.
         """
-        import jax.numpy as jnp
-
         from tpu_dp.analysis.hlo import program_fingerprint
+
+        digest = program_fingerprint(self.train_step,
+                                     self._step_arg_structs())
+        dist.verify_collective_fingerprint(digest, tag=tag)
+        log0("collective-schedule fingerprint (%s): %s", tag, digest[:16])
+
+    def _step_arg_structs(self):
+        """Abstract (state, batch[, guard_in]) args of the shipped per-step
+        program — shared by the DP304 fingerprint check and the
+        cost-analysis FLOPs measurement."""
+        import jax.numpy as jnp
 
         cfg = self.cfg
         gb = cfg.data.batch_size * self.ctx.process_count
@@ -717,9 +789,73 @@ class Trainer:
             from tpu_dp.train.step import guard_in_struct
 
             args = args + (guard_in_struct(),)
-        digest = program_fingerprint(self.train_step, args)
-        dist.verify_collective_fingerprint(digest, tag=tag)
-        log0("collective-schedule fingerprint (%s): %s", tag, digest[:16])
+        return args
+
+    def _register_program_costs(self) -> None:
+        """Stamp this topology's per-step program cost into the registry.
+
+        One optimizer step costs the same FLOPs whether it is dispatched
+        per-step, windowed (`multi_step`) or resident, so one entry is
+        registered under "train_step" and aliased to the other tags the
+        hot loop routes through. Source is the analytic per-model
+        estimate (`tpu_dp.obs.costs`); ``obs.measure_flops=true`` upgrades
+        it to XLA's cost analysis of the real compiled step — the exact
+        resolution order bench.py uses, now shared
+        (docs/OBSERVABILITY.md "Efficiency accounting").
+        """
+        from tpu_dp.obs import costs
+
+        per_chip = self.global_batch_size / max(1, self.num_devices)
+        model = self.cfg.model.name
+        cost = costs.registry.register_analytic("train_step", model,
+                                                per_chip)
+        if self.cfg.obs.measure_flops and self.obs_mode != "off":
+            try:
+                lowered = self.train_step.lower(*self._step_arg_structs())
+                step_flops = costs.cost_analysis_flops(lowered.compile())
+            except Exception:
+                log0("obs.measure_flops: cost-analysis compile failed; "
+                     "keeping the analytic estimate", exc_info=True)
+                step_flops = None
+            if step_flops:
+                resolved, source, check = costs.resolve_flops_per_step(
+                    None, step_flops, 1, per_chip,
+                    costs.train_flops_per_image(model),
+                )
+                cost = costs.registry.register("train_step", resolved,
+                                               source=source, check=check)
+                log0("obs: measured step cost %.3g FLOPs/step/chip "
+                     "(%s, check=%s)", resolved, source, check)
+        if cost is not None:
+            for tag in ("multi_step", f"multi_step[w{self.steps_per_call}]"):
+                costs.registry.alias(tag, "train_step")
+            from tpu_dp.obs.counters import counters as _c
+
+            _c.gauge("obs.flops_per_step_per_chip",
+                     cost.flops_per_step_per_chip)
+
+    def _write_prom(self) -> None:
+        """Atomically rewrite the Prometheus textfile (obs.prom_path).
+
+        Multi-process runs suffix the stable rank so every rank's file
+        can coexist in one scraped directory; failures warn once and
+        never abort training (same contract as heartbeat writes).
+        """
+        path = self.cfg.obs.prom_path
+        if not path:
+            return
+        from tpu_dp.obs.promfile import write_promfile
+
+        out = Path(path)
+        if self.ctx.process_count > 1:
+            out = out.with_name(out.name + f".r{self.stable_rank}")
+        try:
+            write_promfile(out, labels={"rank": str(self.ctx.process_index)})
+        except OSError:
+            if not self._prom_failed:
+                self._prom_failed = True
+                log0("prometheus textfile write failed (suppressing "
+                     "further warnings)", exc_info=True)
 
     def _load_data(self, cfg: Config) -> None:
         """Process 0 materializes the dataset first; the rest then read it.
@@ -963,6 +1099,9 @@ class Trainer:
         if loop is None:
             from tpu_dp.train.step import make_multi_step_resident
 
+            from tpu_dp.obs import costs as _costs
+
+            _costs.registry.alias(f"resident_loop[w{n}]", "train_step")
             loop = self._guarded(f"resident_loop[w{n}]", make_multi_step_resident(
                 self.model, self.optimizer, self.mesh, self.schedule,
                 num_steps=n, use_pallas_xent=self.cfg.train.pallas_xent,
@@ -1097,19 +1236,49 @@ class Trainer:
                     window_spans["device"] = (t4 - t3) * 1e3
                 new_recs = spans.record_window(
                     self._host_step + 1, n, window_spans, ts=ts_wall,
+                    gen=self._rollback_gen,
                 )
+                eff = None
+                if self._eff is not None:
+                    # Live efficiency gauges, per dispatched window: MFU
+                    # from the cost registry (absent when the program's
+                    # cost or the chip's peak is unknown — never a wrong
+                    # number), goodput = 1 − data_wait/window. Window wall
+                    # time is boundary-to-boundary: at obs=full it ends on
+                    # the device fence (honest device time); at basic it
+                    # is a dispatch rate (documented in OBSERVABILITY.md).
+                    if self.resident_train is not None:
+                        tag = f"resident_loop[w{n}]"
+                    else:
+                        tag = "train_step" if n == 1 else "multi_step"
+                    wall_ms = ((t4 if obs_full else t3) - t0) * 1e3
+                    eff = self._eff.observe(
+                        tag, n, wall_ms, window_spans["data_wait"]
+                    )
+                    self._last_efficiency = eff
+                    _obs_counters.gauge("obs.step_time_ms",
+                                        eff["step_time_ms"])
+                    _obs_counters.gauge("obs.goodput", eff["goodput"])
+                    if "mfu" in eff:
+                        _obs_counters.gauge("obs.mfu", eff["mfu"])
                 if obs_full:
-                    # Per-step metrics.jsonl records (schema 2): spans plus
-                    # a counter snapshot, one line per optimizer step.
+                    # Per-step metrics.jsonl records (schema 3): spans,
+                    # the window's efficiency gauges, and a counter
+                    # snapshot — one line per optimizer step.
                     snap = _obs_counters.snapshot()
                     for r in new_recs:
-                        self._log_metrics({
+                        rec = {
                             "step": r["step"],
                             "ts": _iso_ts(r["ts"]),
                             "spans": {k: round(v, 3)
                                       for k, v in r["spans"].items()},
                             "counters": snap,
-                        })
+                        }
+                        if eff is not None:
+                            rec["goodput"] = eff["goodput"]
+                            if "mfu" in eff:
+                                rec["mfu"] = eff["mfu"]
+                        self._log_metrics(rec)
             for m in window:
                 i += 1
                 # On-device async adds; no host sync inside the loop.
@@ -1135,9 +1304,21 @@ class Trainer:
                         # Rank 0 reads every rank's heartbeat file at the
                         # log cadence (already a sync boundary): stragglers
                         # and stale/hung ranks get named while the run is
-                        # still up, not in the postmortem.
-                        issues = self.health.report(self.health.check())
+                        # still up, not in the postmortem. The hang-dump
+                        # sentinel goes out BEFORE report() — on_flag=raise
+                        # must not abort past the request that makes every
+                        # still-stepping rank preserve its black box.
+                        issues = self.health.check()
+                        if self.flightrec is not None:
+                            # Aimed at the dir the recorders POLL (the
+                            # launch obs root) — after a regroup the
+                            # monitor's own run dir is the re-homed
+                            # me<E> dir nobody stats.
+                            self.health.request_dump(
+                                issues, dump_dir=self.flightrec.dump_dir)
+                        self.health.report(issues)
                         self._suspect_from_health(issues)
+                    self._write_prom()
             # The step-lifecycle hook sweep, once per dispatched window
             # (the host-side step boundary): guardrails, snapshot cadence,
             # fault injection, heartbeats, profiling, and the
@@ -1195,8 +1376,11 @@ class Trainer:
         by the time any rank exits, rank 0's final state is committed and
         an auto-restart (`--resume=auto`) loses zero steps.
         """
+        from tpu_dp.obs import flightrec
         from tpu_dp.resilience import PreemptedError
 
+        flightrec.record("preempt_exit", step=self._host_step, epoch=epoch,
+                         done=steps_done)
         log0("preemption: taking final snapshot at epoch %d step %d "
              "(global step %d)", epoch, steps_done, self._host_step)
         self._take_snapshot(epoch, steps_done, wait=True)
@@ -1268,6 +1452,10 @@ class Trainer:
                 log0("elastic: regroup trigger %r at epoch %d step %d "
                      "(global step %d)", trigger, epoch, done,
                      self._host_step)
+                from tpu_dp.obs import flightrec
+
+                flightrec.record("elastic_trigger", step=self._host_step,
+                                 trigger=str(trigger), leaving=leaving)
                 # Rollback flavor: a suspected-dead peer, or an SDC
                 # eviction (the corrupt rank leaves AND everyone resumes
                 # from a pre-corruption save — a graceful final snapshot
@@ -1332,6 +1520,11 @@ class Trainer:
         if self.elastic.sid in plan.leavers:
             self.elastic.confirm_left(done)
             _obs_counters.inc("elastic.departures")
+            from tpu_dp.obs import flightrec
+
+            flightrec.record("elastic_departure", step=self._host_step,
+                             epoch=epoch, done=done, flavor=plan.flavor,
+                             membership_epoch=plan.epoch)
             raise PreemptedError(
                 f"elastic departure at epoch {epoch}, step-in-epoch {done} "
                 f"(global step {self._host_step}); membership epoch "
@@ -1522,10 +1715,17 @@ class Trainer:
             self.elastic.rewind_poll(self._host_step)
         hook.arm_lr_ease(self._host_step)
         _obs_counters.inc("guard.rollbacks")
+        from tpu_dp.obs import flightrec
+
+        flightrec.record("guard_rollback", step=self._host_step,
+                         from_step=from_step, to_step=self._host_step,
+                         gen=self._rollback_gen,
+                         reason=sig.trigger.reason)
         if self.spans is not None:
             self.spans.record_window(
                 self._host_step, 1,
                 {"guard_rollback": 0.0},
+                gen=self._rollback_gen,
             )
         self._log_metrics({
             "event": "guard_rollback",
@@ -1665,9 +1865,19 @@ class Trainer:
         _obs_counters.inc("elastic.regroups")
         _obs_counters.inc("elastic.lost_ranks", old_world - record.world)
         _obs_counters.inc("elastic.regroup_s", dt)
+        from tpu_dp.obs import flightrec
+
+        flightrec.record(
+            "elastic_regroup", step=self._host_step,
+            membership_epoch=record.epoch, flavor=plan.flavor,
+            world=record.world,
+            departed=[d.get("sid") for d in record.departed],
+            regroup_s=round(dt, 3),
+        )
         if self.spans is not None:
             self.spans.record_window(
-                self._host_step, 1, {"elastic_regroup": dt * 1e3}
+                self._host_step, 1, {"elastic_regroup": dt * 1e3},
+                gen=self._rollback_gen,
             )
         self._log_metrics({
             "event": "elastic_regroup",
@@ -1752,20 +1962,22 @@ class Trainer:
         )
 
     def _log_metrics(self, record: dict) -> None:
-        """Append a schema-2 JSON line to the metrics sink (process 0 only).
+        """Append a schema-3 JSON line to the metrics sink (process 0 only).
 
         Structured observability the reference lacks (its only records are
         stdout prints, SURVEY.md §5 "Metrics / logging"). Every record is
         stamped with a wall-clock ``ts`` (ISO-8601 UTC), the global
-        optimizer ``step``, and ``schema: 2`` — the previous schema's
-        records (implicitly v1) carried none of the three, so two runs'
-        logs could not even be aligned in time. Caller-provided fields win
-        (per-step span records carry their own measured ts/step).
+        optimizer ``step``, and ``schema: 3`` — schema 2 added the three
+        stamps (v1 records carried none, so two runs' logs could not even
+        be aligned in time); schema 3 adds the live efficiency fields
+        (``mfu``/``goodput`` on per-step records, the ``efficiency``
+        rollup on epoch records). Caller-provided fields win (per-step
+        span records carry their own measured ts/step).
         """
         if self.ctx.process_index != 0:  # dplint: allow(DP101) host-only IO
             return
         rec = {"ts": _iso_ts(time.time()), "step": self._host_step,
-               "schema": 2}
+               "schema": 3}
         if self._rollback_gen:
             # Rewind guard: post-rollback records name their generation so
             # consumers can drop the tombstoned (replayed-over) steps
@@ -1846,11 +2058,16 @@ class Trainer:
         (train.py's JSON line); None when obs is off."""
         if self.spans is None:
             return None
-        return {
+        out = {
             "mode": self.obs_mode,
             "spans_ms": self.spans.rollup(),
             "counters": _obs_counters.snapshot(),
         }
+        if self._eff is not None:
+            eff = self._eff.rollup()
+            if eff is not None:
+                out["efficiency"] = eff
+        return out
 
     def fit(self) -> dict[str, Any]:
         cfg = self.cfg
@@ -1918,8 +2135,15 @@ class Trainer:
 
                         update_device_memory_gauges()
                         epoch_rec["spans"] = self.spans.rollup()
+                        if self._eff is not None:
+                            # The window-level MFU/goodput/step-time
+                            # rollup obsctl diff reads back post-hoc.
+                            eff_roll = self._eff.rollup()
+                            if eff_roll is not None:
+                                epoch_rec["efficiency"] = eff_roll
                         epoch_rec["counters"] = _obs_counters.snapshot()
                     self._log_metrics(epoch_rec)
+                    self._write_prom()
                     ckpt_meta = {"epoch": epoch, "config": cfg.to_dict(),
                                  "seed": cfg.train.seed}
                     if self.elastic is not None:
@@ -1941,8 +2165,13 @@ class Trainer:
                     if self.health is not None:
                         # End-of-epoch health pass: a rank that went quiet
                         # mid-epoch is flagged here even when log_every
-                        # never fired.
-                        issues = self.health.report(self.health.check())
+                        # never fired (hang-dump sentinel first, as at the
+                        # log boundary).
+                        issues = self.health.check()
+                        if self.flightrec is not None:
+                            self.health.request_dump(
+                                issues, dump_dir=self.flightrec.dump_dir)
+                        self.health.report(issues)
                         self._suspect_from_health(issues)
                     # A signal that lands between epochs (or during eval)
                     # still gets the snapshot-and-exit-143 contract; in
@@ -1983,6 +2212,23 @@ class Trainer:
                      "exception propagates)", exc_info=True)
             if self.preempt is not None:
                 self.preempt.uninstall()
+            # The black box, FIRST among the telemetry teardown: every
+            # exit path out of fit() — clean, PreemptedError (SIGTERM via
+            # the handler's boundary raise), DivergedError,
+            # PeerFailedError, HealthError, any unhandled exception —
+            # leaves flightrec_r<rank>.json, and it must land before any
+            # later teardown step can fail and rob it. dump() never
+            # raises (it logs); the reason names the in-flight exception
+            # so obsctl's timeline shows WHY the rank exited.
+            if self.flightrec is not None:
+                exc = sys.exc_info()
+                reason = "clean" if exc[0] is None else (
+                    f"{exc[0].__name__}: {exc[1]}"[:500]
+                )
+                self.flightrec.record("exit", step=self._host_step,
+                                      reason=reason)
+                self.flightrec.dump(reason=reason)
+            self._write_prom()
             # Telemetry teardown runs on EVERY exit path: a crashed or
             # preempted run is exactly when the trace matters. Each step
             # is guarded separately — a failed profiler flush (disk full,

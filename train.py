@@ -79,6 +79,12 @@ def main(argv=None) -> int:
         # Telemetry rollup (train.obs=basic|full): span percentiles +
         # counters in the same summary line the run already emits.
         summary["obs"] = obs
+        if "efficiency" in obs:
+            # MFU/goodput get headline placement: hardware utilization is
+            # the first-class fleet health signal (arXiv:2204.06514), not
+            # a nested detail — and this is the block `obsctl diff`
+            # cross-checks against BENCH baselines.
+            summary["efficiency"] = obs["efficiency"]
     if trainer.elastic is not None:
         # Elastic rollup: a shrink must be visible in the one-line summary,
         # not only in the membership ledger (docs/RESILIENCE.md).
