@@ -230,10 +230,11 @@ def compile_with_flops(jitted, *eg_args):
 
 
 def _make_step(model, opt, mesh, sched, use_pallas, update_sharding,
-               sentinel=False):
+               sentinel=False, collective_dtype=None, quant_block=None):
     """The production per-step program for the requested update mode:
     GSPMD (`make_train_step`) for replicated, explicit-collectives
-    `make_train_step_shard_map` for the sharded weight update.
+    `make_train_step_shard_map` for the sharded weight update (optionally
+    with the bf16/int8 compressed wire — `--collective-dtype`).
     ``sentinel=True`` builds the guardrail variant (`--guard-overhead`)."""
     from tpu_dp.train import make_train_step, make_train_step_shard_map
 
@@ -241,6 +242,8 @@ def _make_step(model, opt, mesh, sched, use_pallas, update_sharding,
         return make_train_step_shard_map(
             model, opt, mesh, sched, use_pallas_xent=use_pallas,
             update_sharding=update_sharding, sentinel=sentinel,
+            collective_dtype=collective_dtype or None,
+            quant_block_size=quant_block,
         )
     return make_train_step(model, opt, mesh, sched,
                            use_pallas_xent=use_pallas, sentinel=sentinel)
@@ -275,6 +278,8 @@ def measure_point(cfg: dict) -> dict:
     use_pallas = bool(cfg["pallas_xent"])
     fused_stages = str(cfg.get("fused_stages", "") or "")
     update_sharding = str(cfg.get("update_sharding", "replicated"))
+    collective_dtype = str(cfg.get("collective_dtype", "") or "")
+    quant_block = int(cfg.get("quant_block_size", 256))
     model_name = cfg.get("model", "resnet18")
     flops_per_image, num_classes = MODEL_SPECS[model_name]
     metric = metric_for(model_name, num_classes)
@@ -300,6 +305,11 @@ def measure_point(cfg: dict) -> dict:
     state = create_train_state(
         model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32), opt
     )
+    if collective_dtype in ("int8", "i8"):
+        from tpu_dp.parallel import quant as quant_mod
+
+        state = state.replace(residuals=quant_mod.init_residuals(
+            state.params, n_chips, quant_block))
     # Two windows execute (compile+warmup, then measured): schedule horizon
     # covers both so the measured steps run at real cosine LRs.
     sched = cosine_lr(0.4, 2 * measure_steps, 2)
@@ -316,7 +326,9 @@ def measure_point(cfg: dict) -> dict:
     if window > 1:
         loop = make_multi_step(model, opt, mesh, sched, num_steps=window,
                                use_pallas_xent=use_pallas,
-                               update_sharding=update_sharding)
+                               update_sharding=update_sharding,
+                               collective_dtype=collective_dtype or None,
+                               quant_block_size=quant_block)
         stacked = {
             "image": np.stack([d.images for d in host_pool]),
             "label": np.stack([d.labels for d in host_pool]),
@@ -335,7 +347,9 @@ def measure_point(cfg: dict) -> dict:
         step_flops = None  # resolved below, after the provisional record
     else:
         step = _make_step(model, opt, mesh, sched, use_pallas,
-                          update_sharding)
+                          update_sharding,
+                          collective_dtype=collective_dtype,
+                          quant_block=quant_block)
         batches = [
             shard_batch({"image": d.images, "label": d.labels}, mesh,
                         spec=batch_sharding(mesh))
@@ -364,6 +378,7 @@ def measure_point(cfg: dict) -> dict:
     # not host-observable); the fence cost makes these latency numbers —
     # the throughput headline stays the unfenced measurement.
     latency_rec = None
+    quant_overflow = quant_clip = quant_steps = 0
     lat_steps = int(cfg.get("latency_steps", 20))
     if lat_steps > 0:
         from tpu_dp.obs.spans import SpanRecorder
@@ -385,6 +400,12 @@ def measure_point(cfg: dict) -> dict:
             dt_ms = (time.perf_counter() - t0) * 1e3
             rec.record_window(step_i, max(1, window), {"step": dt_ms})
             step_i += max(1, window)
+            if "quant_overflow" in m:
+                # Codec-health totals ride the fenced pass (the fetch is
+                # already paid): overflow/clip block counts per step.
+                quant_overflow += int(np.asarray(m["quant_overflow"]).sum())
+                quant_clip += int(np.asarray(m["quant_clip"]).sum())
+                quant_steps += max(1, window)
         roll = rec.rollup()["step"]
         latency_rec = {
             "p50_ms": roll["p50"], "p95_ms": roll["p95"],
@@ -529,6 +550,23 @@ def measure_point(cfg: dict) -> dict:
             "bucket_counts": srep["bucket_counts"],
         }
 
+    quant_rec = None
+    if collective_dtype:
+        # The wire-accounting block (docs/PERF.md "Quantized collectives"):
+        # bytes each wire format puts on the gradient reduce-scatter per
+        # step, plus the codec's measured overflow/clip totals over the
+        # fenced latency steps. Present for bf16 too (the byte math is the
+        # point of the knob); overflow/clip only exist on the int8 path.
+        from tpu_dp.parallel import quant as quant_mod
+
+        quant_rec = quant_mod.wire_report(state.params, n_chips,
+                                          quant_block)
+        quant_rec["collective_dtype"] = collective_dtype
+        if collective_dtype in ("int8", "i8"):
+            quant_rec["overflow"] = quant_overflow
+            quant_rec["clip_blocks"] = quant_clip
+            quant_rec["stats_steps"] = quant_steps
+
     images_per_sec = n_steps_timed * global_batch / elapsed
     per_chip_ips = images_per_sec / n_chips
     device_kind = jax.devices()[0].device_kind
@@ -573,10 +611,14 @@ def measure_point(cfg: dict) -> dict:
                 "fused_stages": fused_stages,
                 "fused_bwd": bool(cfg.get("fused_bwd", False)),
                 "update_sharding": update_sharding,
+                "collective_dtype": collective_dtype,
+                "quant_block_size": quant_block,
             },
         }
         if latency_rec is not None:
             rec["latency"] = latency_rec
+        if quant_rec is not None:
+            rec["quant"] = quant_rec
         if snapshot_rec is not None:
             rec["snapshot"] = snapshot_rec
         if guard_rec is not None:
@@ -722,6 +764,18 @@ def main() -> None:
                          "params+momentum per chip, all-gathers updated "
                          "params (docs/PERF.md); recorded in the BENCH "
                          "json config block")
+    ap.add_argument("--collective-dtype", default="",
+                    choices=["", "bf16", "int8"],
+                    help="wire format of the sharded update's gradient "
+                         "reduce-scatter (train.collective_dtype): bf16 "
+                         "casts the payload, int8 is the blockwise-scaled "
+                         "codec with error feedback; requires "
+                         "--update-sharding sharded. The record gains a "
+                         "'quant' block (wire bytes per step f32/bf16/"
+                         "int8, overflow/clip counts)")
+    ap.add_argument("--quant-block-size", type=int, default=256,
+                    help="scaling-block length of the int8 wire codec "
+                         "(train.quant_block_size)")
     ap.add_argument("--latency-steps", type=int, default=20,
                     help="fenced per-step latency sample size for the "
                          "p50/p95/p99 'latency' block (tpu_dp.obs.spans; "
@@ -766,6 +820,9 @@ def main() -> None:
     if args.sweep and args.sweep_fused:
         ap.error("--sweep and --sweep-fused are mutually exclusive; "
                  "run them as two invocations (both archive)")
+    if args.collective_dtype and args.update_sharding != "sharded":
+        ap.error("--collective-dtype requires --update-sharding sharded "
+                 "(the wire format lives on the reduce-scatter)")
 
     if args._measure is not None:
         emit(measure_point(json.loads(args._measure)))
@@ -821,6 +878,8 @@ def main() -> None:
             "guard_overhead_steps": args.guard_overhead,
             "latency_steps": args.latency_steps,
             "update_sharding": args.update_sharding,
+            "collective_dtype": args.collective_dtype,
+            "quant_block_size": args.quant_block_size,
             "serve_requests": args.serve_requests if args.serve else 0,
             "serve_rate_rps": args.serve_rate,
             "serve_slo_ms": args.serve_slo_ms}

@@ -122,7 +122,8 @@ def test_shipped_sharded_steps_have_scatter_update_gather_schedule(repo_hlo):
     the scatter)."""
     _, artifact = repo_hlo
     sharded = {k: v for k, v in artifact["programs"].items()
-               if v["update_sharding"] == "sharded"}
+               if v["update_sharding"] == "sharded"
+               and v.get("wire", "f32") != "int8"}
     assert sharded, "no sharded programs in the shipped artifact"
     for name, rec in sharded.items():
         counts = rec["counts"]
@@ -151,6 +152,132 @@ def test_shipped_sharded_steps_have_scatter_update_gather_schedule(repo_hlo):
         assert rec["aliased_inputs"] == rec["donated_inputs"] > 0, name
 
 
+def test_shipped_int8_steps_have_quantized_schedule(repo_hlo):
+    """The quantized-wire programs (`train.collective_dtype=int8`) compile
+    to the THIRD legal schedule: int8 payload all-to-alls + f32 scale
+    all-to-alls over the one full-mesh group for the quantizable leaves,
+    plain reduce-scatters for the small-leaf fallback, the params
+    all-gather, 4 declared metric scalars (loss, correct, overflow, clip;
+    +1 for the sentinel's grad-norm psum) — and NO non-scalar all-reduce
+    (every gradient leaf really went through a scatter path). Donation
+    survives, residual buffers included."""
+    _, artifact = repo_hlo
+    int8 = {k: v for k, v in artifact["programs"].items()
+            if v.get("wire") == "int8"}
+    assert set(int8) == {
+        "train_step[shard_map,sharded,int8]@accum1",
+        "multi_step[sharded,int8]@w2",
+        "train_step[shard_map,sharded,int8,sentinel]@accum1",
+    }
+    for name, rec in int8.items():
+        counts = rec["counts"]
+        assert counts.get("all-to-all", 0) >= 2, (name, counts)
+        by_kind = {}
+        for op in rec["collectives"]:
+            by_kind.setdefault(op["kind"], []).append(op)
+        payload = [op for op in by_kind["all-to-all"] if "s8[" in op["shape"]]
+        scales = [op for op in by_kind["all-to-all"] if "f32[" in op["shape"]]
+        assert payload, (name, "no int8 payload exchange compiled")
+        assert len(payload) + len(scales) == len(by_kind["all-to-all"])
+        # One exchange group, matching the params gather's.
+        groups = {op["replica_groups"] for op in by_kind["all-to-all"]}
+        gather_groups = {op["replica_groups"] for op in by_kind["all-gather"]}
+        assert len(groups) == 1 and groups == gather_groups, (
+            name, groups, gather_groups)
+        # Small-leaf fallback keeps the uncompressed scatter; no gradient
+        # rides a non-scalar float all-reduce.
+        assert by_kind.get("reduce-scatter"), name
+        non_scalar_ar = [op for op in by_kind.get("all-reduce", [])
+                         if "[]" not in op["shape"]]
+        assert non_scalar_ar == [], (name, non_scalar_ar)
+        declared = 5 if "sentinel" in name else 4
+        assert rec["metric_allreduce_ops"] == declared, (
+            name, rec["metric_allreduce_ops"])
+        # Donation survives the residual state: every donated leaf —
+        # params, opt shards, AND the f32[world, qpad] residuals — aliases.
+        assert rec["aliased_inputs"] == rec["donated_inputs"] > 0, name
+    # The wire format is fingerprint-visible: an int8-configured rank
+    # cannot impersonate an uncompressed one (DP304 catches the config
+    # divergence before the first mismatched collective deadlocks).
+    progs = artifact["programs"]
+    assert (progs["train_step[shard_map,sharded,int8]@accum1"]["digest"]
+            != progs["train_step[shard_map,sharded]@accum1"]["digest"])
+
+
+def test_no_int8_wire_ops_outside_opted_in_programs(repo_hlo):
+    """The blanket no-leak guarantee: across EVERY shipped program that did
+    not opt into the quantized wire — GSPMD and shard_map train steps,
+    sharded f32/bf16 steps, multi-step windows, eval, serve buckets,
+    sentinel variants — the compiled module contains zero all-to-all ops
+    and zero int8-typed collectives of any kind. Compression can never
+    silently leak into a program that didn't ask for it."""
+    _, artifact = repo_hlo
+    checked = 0
+    for name, rec in artifact["programs"].items():
+        if rec.get("wire") == "int8":
+            continue
+        checked += 1
+        assert "all-to-all" not in rec["counts"], (name, rec["counts"])
+        int8_ops = [op for op in rec["collectives"]
+                    if "s8[" in op["shape"] or "u8[" in op["shape"]]
+        assert int8_ops == [], (name, int8_ops)
+    assert checked >= 10  # the full non-quantized program matrix
+
+
+def test_dp301_fires_on_int8_leak_and_missing_payload():
+    """DP301's int8 rules both ways: an all-to-all in a NON-int8 program
+    is flagged as a compression leak, and an int8-declared program with no
+    s8 exchange is flagged as silently uncompressed."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dp.parallel import dist
+    from tpu_dp.train.step import _shard_map
+
+    mesh = dist.data_mesh()
+
+    def leak(g):
+        q = jnp.clip(jnp.round(g), -127, 127).astype(jnp.int8)
+        qx = jax.lax.all_to_all(q.reshape(8, -1), dist.DATA_AXIS,
+                                split_axis=0, concat_axis=0, tiled=True)
+        return jnp.sum(qx.astype(jnp.float32), axis=0)
+
+    fn = jax.jit(_shard_map(leak, mesh, (P(dist.DATA_AXIS),),
+                            P(dist.DATA_AXIS)))
+    text, _, _ = hlo.lower_and_compile(
+        fn, (jnp.zeros((8, 64), jnp.float32),))
+    findings, _ = hlo.analyze_module(
+        text, label="leak", where=("x.py", 1), world=8,
+        update_sharding="sharded",
+    )
+    assert any("leaked" in f.message and f.rule == "DP301"
+               for f in findings), findings
+
+    # Same module declared int8 passes the leak rule...
+    ok, rec = hlo.analyze_module(
+        text, label="ok", where=("x.py", 1), world=8,
+        update_sharding="sharded", wire="int8",
+    )
+    assert not any("leaked" in f.message for f in ok)
+    assert rec["wire"] == "int8"
+
+    # ...and an int8-declared program with NO s8 exchange fires.
+    def plain(g):
+        flat = jnp.pad(g.reshape(-1), (0, (-g.size) % 8))
+        shard = jax.lax.psum_scatter(flat, dist.DATA_AXIS,
+                                     scatter_dimension=0, tiled=True)
+        return jax.lax.all_gather(shard, dist.DATA_AXIS, axis=0,
+                                  tiled=True)[: g.size]
+
+    fn2 = jax.jit(_shard_map(plain, mesh, (P(),), P()))
+    text2, _, _ = hlo.lower_and_compile(fn2, (jnp.zeros((64,), jnp.float32),))
+    findings2, _ = hlo.analyze_module(
+        text2, label="uncompressed", where=("x.py", 1), world=8,
+        update_sharding="sharded", wire="int8", expect_grad_reduce=True,
+    )
+    assert any("NO int8" in f.message for f in findings2), findings2
+
+
 def test_sentinel_programs_in_artifact(repo_hlo):
     """The guardrail sentinel variants are fingerprinted alongside the
     plain steps (docs/RESILIENCE.md "Guardrails"): replicated/GSPMD
@@ -167,11 +294,15 @@ def test_sentinel_programs_in_artifact(repo_hlo):
         "train_step[gspmd,sentinel]@accum1",
         "train_step[shard_map,sentinel]@accum1",
         "train_step[shard_map,sharded,sentinel]@accum1",
+        "train_step[shard_map,sharded,int8,sentinel]@accum1",
         "multi_step[sentinel]@w2",
     }
     for name, rec in sentinel.items():
         assert rec["aliased_inputs"] == rec["donated_inputs"] > 0, name
-        if rec["update_sharding"] == "sharded":
+        if rec.get("wire", "f32") == "int8":
+            # Sharded sentinel's 3 plus the codec's overflow/clip psums.
+            assert rec["metric_allreduce_ops"] == 5, name
+        elif rec["update_sharding"] == "sharded":
             assert rec["metric_allreduce_ops"] == 3, name
         else:
             assert set(rec["counts"]) <= {"all-reduce"}, (name, rec["counts"])

@@ -61,6 +61,17 @@
 #                                    # and merged trace, then the -m obs
 #                                    # tests (which now cover flightrec /
 #                                    # costs / promfile / obsctl).
+#   tools/run_tier1.sh --quant      # quantized-collectives lane: an int8
+#                                    # BENCH point on the 8-device CPU
+#                                    # mesh with exit-coded quant-block
+#                                    # checks (wire compression > 3x vs
+#                                    # f32, zero overflow blocks; archives
+#                                    # artifacts/quant_report.json), then
+#                                    # the -m quant suite (codec units,
+#                                    # f32/bf16/int8 parity harness,
+#                                    # error-feedback ablation, guard/NaN
+#                                    # interaction, residual checkpoint
+#                                    # resharding + kill/resume).
 #   tools/run_tier1.sh --serve       # serving lane: a 200-request mixed-
 #                                    # size synthetic load through the full
 #                                    # queue → batcher → compiled-forward
@@ -259,6 +270,61 @@ PY
     rm -rf "$SMOKE"
     echo "obsctl lane: artifacts/obsctl_report.json + obsctl_timeline*.json + obsctl_trace.json"
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--quant" ]; then
+    # Quantized-collectives lane (docs/PERF.md "Quantized collectives"):
+    # a BENCH point through the real int8 wire path on the 8-virtual-
+    # device CPU mesh, exit-coded checks on its quant block (the wire
+    # byte accounting must show real compression and a clean overflow
+    # count), archived as artifacts/quant_report.json — then the -m quant
+    # suite (codec units, the f32/bf16/int8 parity harness, the
+    # error-feedback ablation, guard/NaN interaction, checkpoint
+    # resharding + kill/resume, analyzer rules, obsctl gating).
+    mkdir -p artifacts
+    env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python bench.py --platform cpu --model resnet18 \
+        --per-chip-batch 8 --measure-steps 3 --steps-per-call 1 \
+        --latency-steps 4 --update-sharding sharded \
+        --collective-dtype int8 --point-timeout 420 \
+        > /tmp/_quant_bench.out || exit $?
+    env JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+import json
+from pathlib import Path
+rec = None
+for line in reversed(Path("/tmp/_quant_bench.out").read_text().splitlines()):
+    line = line.strip()
+    if line.startswith("{"):
+        rec = json.loads(line)
+        break
+assert rec and rec.get("value"), rec
+q = rec.get("quant")
+assert q, "BENCH record has no quant block"
+b = q["wire_bytes_per_step"]
+assert b["int8"] < b["bf16"] < b["f32"], b
+assert q["compression_vs_f32"] > 3.0, q
+assert q["overflow"] == 0, f"non-finite blocks in a clean run: {q}"
+assert q["stats_steps"] > 0 and "clip_blocks" in q, q
+assert rec["config"]["collective_dtype"] == "int8", rec["config"]
+assert rec["latency"]["n_steps"] > 0, rec
+report = {
+    "ok": True,
+    "metric": rec["metric"],
+    "value": rec["value"],
+    "backend": rec["backend"],
+    "latency": rec["latency"],
+    "quant": q,
+    "config": rec["config"],
+}
+Path("artifacts/quant_report.json").write_text(
+    json.dumps(report, indent=2) + "\n")
+print("quant smoke:", json.dumps({"compression_vs_f32":
+      q["compression_vs_f32"], "overflow": q["overflow"],
+      "clip_blocks": q["clip_blocks"]}))
+PY
+    echo "quant smoke: artifacts/quant_report.json"
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m quant \
         -p no:cacheprovider
 fi
 

@@ -213,15 +213,19 @@ def main(argv: list[str] | None = None) -> int:
             if has_repo_step:
                 from tpu_dp.analysis import gradsync
 
-                # Both legal update schedules: the replicated gradient
-                # pmean and the sharded reduce-scatter path
-                # (train.update_sharding) each carry the exactly-one-
+                # Every legal update schedule: the replicated gradient
+                # pmean, the sharded reduce-scatter path
+                # (train.update_sharding), and the quantized int8 wire
+                # (train.collective_dtype=int8 — the payload all_to_all is
+                # the counted reduction) each carry the exactly-one-
                 # reduction-per-leaf contract.
                 for accum in accum_variants:
-                    for mode in ("replicated", "sharded"):
+                    for mode, wire in (("replicated", None),
+                                       ("sharded", None),
+                                       ("sharded", "int8")):
                         got, _ = gradsync.verify_repo_step(
                             accum_steps=accum, world=args.world,
-                            update_sharding=mode,
+                            update_sharding=mode, collective_dtype=wire,
                         )
                         findings.extend(got)
             for f in files:

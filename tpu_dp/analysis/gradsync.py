@@ -41,6 +41,35 @@ from tpu_dp.analysis.report import Finding
 # sharded update is NOT a reduction and is deliberately absent here.
 _REDUCTION_PRIMS = {"psum", "pmin", "pmax", "psum2", "reduce_scatter"}
 
+# The int8 wire codec (`train.collective_dtype=int8`,
+# `parallel/collectives.py psum_scatter_quant`) carries the gradient
+# reduction as a quantized exchange: ONE int8 `all_to_all` (the payload —
+# each replica then dequantizes and locally sums the world chunks it
+# received; the local reduce_sum is the reduction's arithmetic, the
+# all_to_all is its data-axis leg). An all_to_all is NOT a reduction in
+# general — only the **int8-typed** exchange on a gradient's backward
+# slice counts as that leaf's data-axis reduction. The f32 *scales*
+# all_to_all riding alongside is wire metadata, deliberately not counted
+# (same status as the params all-gather above): counting it would make
+# every quantized leaf read as twice-reduced (a false DP202) while a real
+# double reduction — two int8 exchanges, or an int8 exchange plus a psum
+# — still fires.
+_QUANT_WIRE_PRIM = "all_to_all"
+
+
+def _is_quant_wire_reduction(eqn) -> bool:
+    """True when ``eqn`` is the int8 payload exchange of the quantized
+    reduce-scatter (int8-typed all_to_all; f32 scales don't count)."""
+    if eqn.primitive.name != _QUANT_WIRE_PRIM:
+        return False
+    import numpy as np
+
+    try:
+        dtype = eqn.invars[0].aval.dtype
+    except (AttributeError, IndexError):
+        return False
+    return dtype == np.int8
+
 _PARAM_KEY = re.compile(r"\bparams\b")
 
 
@@ -112,7 +141,8 @@ def _count_reductions(jaxpr, target_outvars, axis: str) -> int:
 
     count = 0
     for eqn in sliced_eqns:
-        if eqn.primitive.name in _REDUCTION_PRIMS:
+        if eqn.primitive.name in _REDUCTION_PRIMS \
+                or _is_quant_wire_reduction(eqn):
             axes = eqn.params.get("axes") or eqn.params.get("axis_name") or ()
             if isinstance(axes, str):
                 axes = (axes,)
@@ -250,6 +280,8 @@ def verify_repo_step(
     batch_size: int = 4,
     world: int = 8,
     update_sharding: str = "replicated",
+    collective_dtype: str | None = None,
+    quant_block_size: int | None = None,
     **model_kwargs,
 ) -> tuple[list[Finding], dict[str, int]]:
     """Verify the shipped train step's gradient-sync contract.
@@ -265,6 +297,14 @@ def verify_repo_step(
     leaf is a `reduce_scatter` (counted by `_REDUCTION_PRIMS` exactly like
     psum), followed by a non-reducing params all-gather — so the
     exactly-once invariant holds unchanged across both modes.
+
+    ``collective_dtype="int8"`` verifies the quantized-wire program
+    (`train.collective_dtype=int8`): quantizable leaves' reduction is the
+    int8-payload `all_to_all` (`_is_quant_wire_reduction`; the f32 scales
+    exchange is uncounted metadata), small leaves keep the plain
+    `reduce_scatter` — still exactly one data-axis reduction per leaf.
+    The traced state carries the per-replica view of the error-feedback
+    residuals (`quant.local_residuals`), like the opt-state shards.
 
     Models constructed with ``axis_name`` (sync-BN) perform in-forward
     data-axis collectives whose AD transposes land on the gradient path,
@@ -302,18 +342,29 @@ def verify_repo_step(
         state = state.replace(
             opt_state=optimizer.local_view(state.opt_state)
         )
+    if collective_dtype in ("int8", "i8"):
+        from tpu_dp.parallel import quant
+
+        block = quant_block_size or quant.DEFAULT_BLOCK_SIZE
+        state = state.replace(residuals=quant.local_residuals(
+            quant.init_residuals(state.params, world, block), world
+        ))
     local_step = make_local_step(
         model, optimizer, constant_lr(0.1),
         accum_steps=accum_steps, world=world, axis_name=DATA_AXIS,
         cast_params=False,  # trace outside a real shard_map scope
         update_sharding=update_sharding,
+        collective_dtype=collective_dtype,
+        quant_block_size=quant_block_size,
     )
+    wire = f", collective_dtype={collective_dtype!r}" \
+        if collective_dtype else ""
     return verify_local_step(
         local_step,
         (state, _example_batch(accum_steps, batch_size)),
         axis=DATA_AXIS, world=world,
         label=f"make_local_step(model={model_name!r}, "
               f"accum_steps={accum_steps}, "
-              f"update_sharding={update_sharding!r})",
+              f"update_sharding={update_sharding!r}{wire})",
         exact=exact,
     )

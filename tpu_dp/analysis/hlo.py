@@ -227,6 +227,7 @@ def analyze_module(
     expect_fingerprint: str | None = None,
     donation_warnings: Sequence[str] = (),
     update_sharding: str = "replicated",
+    wire: str = "f32",
 ) -> tuple[list[Finding], dict]:
     """Run DP301–DP304 over one compiled module's text.
 
@@ -238,6 +239,18 @@ def analyze_module(
     the identical full-mesh replica groups, plus the metric scalars — and
     no non-scalar all-reduce (a gradient leaf that bypassed the scatter
     path and was all-reduced anyway defeats the sharded update).
+
+    ``wire="int8"`` (with sharded mode — `train.collective_dtype=int8`)
+    admits the THIRD legal schedule, the quantized reduce-scatter: the
+    gradient exchange is `all-to-all` ops that must be **int8-typed
+    payload** or **f32 scales** and nothing else, over the same full-mesh
+    replica group as the params all-gather; at least one int8 exchange
+    must exist (a "quantized" program with no s8 wire op silently ran
+    uncompressed), small leaves may keep plain reduce-scatters, and a
+    non-scalar float all-reduce still means a gradient bypassed the
+    compressed path. Any all-to-all in a NON-int8 program stays illegal —
+    the blanket guarantee that compression can never leak into a program
+    that did not opt in.
 
     Returns (findings, record) where the record is the program's entry in
     the collective-fingerprint artifact.
@@ -253,10 +266,27 @@ def analyze_module(
 
     # -- DP301: classify every collective --------------------------------
     sharded = update_sharding == "sharded"
-    legal_kinds = ("all-reduce", "reduce-scatter", "all-gather") if sharded \
-        else ("all-reduce",)
+    int8_wire = wire == "int8"
+    if int8_wire and not sharded:
+        raise ValueError("wire='int8' applies to sharded-mode programs")
+    if int8_wire:
+        legal_kinds = ("all-reduce", "reduce-scatter", "all-gather",
+                       "all-to-all")
+    elif sharded:
+        legal_kinds = ("all-reduce", "reduce-scatter", "all-gather")
+    else:
+        legal_kinds = ("all-reduce",)
     bad_kinds = [op for op in collectives if op.kind not in legal_kinds]
     for op in bad_kinds:
+        if op.kind == "all-to-all":
+            emit("DP301",
+                 f"compiled program contains `all-to-all` {op.shape} "
+                 f"(replica_groups={op.replica_groups or '?'}) — the "
+                 f"quantized-wire exchange is legal ONLY in programs "
+                 f"compiled with collective_dtype=int8; in this program "
+                 f"it means wire compression leaked into a path that "
+                 f"never opted in")
+            continue
         emit("DP301",
              f"compiled program contains `{op.kind}` {op.shape} "
              f"(replica_groups={op.replica_groups or '?'}) — a "
@@ -267,9 +297,41 @@ def analyze_module(
     allreduces = [op for op in collectives if op.kind == "all-reduce"]
     scatters = [op for op in collectives if op.kind == "reduce-scatter"]
     gathers = [op for op in collectives if op.kind == "all-gather"]
+    a2as = [op for op in collectives if op.kind == "all-to-all"]
     metric_ars = [op for op in allreduces if op.is_scalar]
+    if int8_wire:
+        payload_a2as = [op for op in a2as if "s8[" in op.shape]
+        scale_a2as = [op for op in a2as if "f32[" in op.shape]
+        stray_a2as = [op for op in a2as
+                      if op not in payload_a2as and op not in scale_a2as]
+        for op in stray_a2as:
+            emit("DP301",
+                 f"`all-to-all` {op.shape} is neither the int8 payload "
+                 f"nor the f32 scales — the quantized wire format is "
+                 f"s8 payload + f32 scales, nothing else rides the "
+                 f"gradient exchange")
+        if expect_grad_reduce and world > 1 and not payload_a2as:
+            emit("DP301",
+                 "collective_dtype=int8 program compiles NO int8 "
+                 "all-to-all — every gradient leaf silently took the "
+                 "uncompressed path; the wire-compression knob did "
+                 "nothing")
+        a2a_groups = {op.replica_groups for op in a2as}
+        if len(a2a_groups) > 1:
+            emit("DP301",
+                 f"quantized exchanges use {len(a2a_groups)} distinct "
+                 f"replica groupings ({sorted(a2a_groups)}) — one data "
+                 f"axis means one exchange group")
+        gather_groups = {op.replica_groups for op in gathers}
+        if a2as and gathers and a2a_groups != gather_groups:
+            emit("DP301",
+                 f"int8 exchange replica groups {sorted(a2a_groups)} do "
+                 f"not match the params all-gather groups "
+                 f"{sorted(gather_groups)} — the quantized scatter and "
+                 f"the gather run over different axes")
     if sharded:
-        grad_ars = scatters
+        grad_ars = scatters + ([op for op in a2as if "s8[" in op.shape]
+                               if int8_wire else [])
         stray_ars = [op for op in allreduces if not op.is_scalar]
         for op in stray_ars:
             emit("DP301",
@@ -309,7 +371,7 @@ def analyze_module(
                  f"gradient reduce-scatter group mixes reduction kinds "
                  f"(add + {non_add}) — a non-add reduction on the gradient "
                  f"path cannot fuse into the single combined reduce-scatter")
-        if expect_grad_reduce and world > 1 and not scatters:
+        if expect_grad_reduce and world > 1 and not grad_ars:
             emit("DP301",
                  "no reduce-scatter in the compiled sharded-update train "
                  "step — the gradient reduction the DDP contract requires "
@@ -387,6 +449,11 @@ def analyze_module(
         # differently), but a reviewer diffing the artifact should not have
         # to infer the mode from the op list.
         "update_sharding": update_sharding,
+        # Which wire format the program was compiled for ("f32" covers the
+        # bf16 cast too — the cast is payload dtype, not schedule shape;
+        # "int8" marks the quantized all-to-all schedule, and the blanket
+        # no-leak test keys off this field).
+        "wire": wire,
         "collectives": [op.to_dict() for op in collectives],
         "counts": count_collectives(text),
         # Mode-neutral name: in sharded mode the gradient-reduction ops are
@@ -460,11 +527,20 @@ def shipped_programs(
         model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
         sharded_opt,
     )
+    # The quantized-wire state: error-feedback residuals ride along,
+    # flat-sharded like the opt state (tpu_dp/parallel/quant.py).
+    from tpu_dp.parallel import quant as quant_mod
+
+    int8_state = sharded_state.replace(
+        residuals=quant_mod.init_residuals(sharded_state.params, world)
+    )
     n_state = len(jax.tree_util.tree_leaves(state))
+    n_int8_state = len(jax.tree_util.tree_leaves(int8_state))
     batch = 2 * world
     path = _step_py_path()
 
-    def spec(factory, donated, metrics, grad, mode="replicated"):
+    def spec(factory, donated, metrics, grad, mode="replicated",
+             wire="f32"):
         return {
             "donated_leaves": donated,
             "metric_reductions": metrics,
@@ -472,6 +548,7 @@ def shipped_programs(
             "where": (path, factory.__code__.co_firstlineno),
             "world": world,
             "update_sharding": mode,
+            "wire": wire,
         }
 
     for accum in accum_steps:
@@ -503,6 +580,23 @@ def shipped_programs(
             spec(step_mod.make_train_step_shard_map, n_state, 2, True,
                  mode="sharded"),
         )
+    # The quantized-wire variants (train.collective_dtype=int8): the THIRD
+    # legal schedule — int8 payload + f32 scale all-to-alls for the
+    # quantizable leaves, plain reduce-scatters for the small-leaf
+    # fallback, the params all-gather, and FOUR declared metric scalars
+    # (loss, correct, and the codec's overflow/clip counts).
+    for accum in accum_steps:
+        prefix = () if accum == 1 else (accum,)
+        yield (
+            f"train_step[shard_map,sharded,int8]@accum{accum}",
+            step_mod.make_train_step_shard_map(
+                model, sharded_opt, mesh, sched, accum_steps=accum,
+                update_sharding="sharded", collective_dtype="int8",
+            ),
+            (int8_state, _example_batch(batch, prefix)),
+            spec(step_mod.make_train_step_shard_map, n_int8_state, 4, True,
+                 mode="sharded", wire="int8"),
+        )
     yield (
         "multi_step@w2",
         step_mod.make_multi_step(model, opt, mesh, sched, num_steps=2),
@@ -515,6 +609,15 @@ def shipped_programs(
                                  num_steps=2, update_sharding="sharded"),
         (sharded_state, _example_batch(batch, (2,))),
         spec(step_mod.make_multi_step, n_state, 2, True, mode="sharded"),
+    )
+    yield (
+        "multi_step[sharded,int8]@w2",
+        step_mod.make_multi_step(model, sharded_opt, mesh, sched,
+                                 num_steps=2, update_sharding="sharded",
+                                 collective_dtype="int8"),
+        (int8_state, _example_batch(batch, (2,))),
+        spec(step_mod.make_multi_step, n_int8_state, 4, True,
+             mode="sharded", wire="int8"),
     )
     yield (
         "eval_step",
@@ -555,6 +658,21 @@ def shipped_programs(
         (sharded_state, _example_batch(batch), gi),
         spec(step_mod.make_train_step_shard_map, n_state, 3, True,
              mode="sharded"),
+    )
+    # Guard + quantized wire together (the interaction the guard suite
+    # proves: sentinel health reads the DEQUANTIZED post-reduce gradients,
+    # and a skipped batch's residuals revert with the rest of the state):
+    # 5 declared scalars — loss, correct, cross-shard grad-norm psum,
+    # overflow, clip.
+    yield (
+        "train_step[shard_map,sharded,int8,sentinel]@accum1",
+        step_mod.make_train_step_shard_map(
+            model, sharded_opt, mesh, sched, update_sharding="sharded",
+            collective_dtype="int8", sentinel=True,
+        ),
+        (int8_state, _example_batch(batch), gi),
+        spec(step_mod.make_train_step_shard_map, n_int8_state, 5, True,
+             mode="sharded", wire="int8"),
     )
     yield (
         "multi_step[sentinel]@w2",
@@ -618,6 +736,7 @@ def verify_repo_hlo(
             expect_grad_reduce=spec["expect_grad_reduce"],
             donation_warnings=donation_warns,
             update_sharding=spec.get("update_sharding", "replicated"),
+            wire=spec.get("wire", "f32"),
         )
         findings.extend(got)
         record.update(stats)
@@ -730,5 +849,6 @@ def verify_hlo_hook(path: str, module: Any, world: int) -> list[Finding]:
         expect_fingerprint=decl.get("expect_fingerprint"),
         donation_warnings=donation_warns,
         update_sharding=str(decl.get("update_sharding", "replicated")),
+        wire=str(decl.get("wire", "f32")),
     )
     return findings

@@ -179,6 +179,99 @@ def _maybe_reshard_opt_state(raw: Any, host_target: TrainState) -> Any:
     return raw
 
 
+def _reconcile_residuals(raw: Any, host_target: TrainState) -> Any:
+    """Reconcile the int8 codec's error-feedback residuals on restore.
+
+    The residual state (`tpu_dp.parallel.quant`; ``TrainState.residuals``)
+    is a dict of ``f32[world, qpad]`` leaves keyed by params-leaf path.
+    Restores must survive every transition the opt state survives:
+
+    - **older checkpoint, no residuals at all** (pre-codec, or written with
+      the codec off) → zero-initialized residuals shaped like the target
+      (error feedback restarts; the pending correction it forgets is
+      bounded by ONE step's quantization error);
+    - **codec turned off** (target carries none) → saved residuals are
+      dropped;
+    - **same layout** → exact round trip (the kill+resume contract);
+    - **world size or block size changed** → *pending-correction-
+      preserving* reshard (`_relayout_residual_leaf`): the sum of every
+      replica's pending error is remapped from the old per-chunk layout
+      into replica 0's row of the new layout, zeros elsewhere — the total
+      un-transmitted correction Σ_r residual_r is exactly what error
+      feedback owes the trajectory, and replica 0 pays the whole debt on
+      its first post-restore step;
+    - **the quantizable-leaf set changed** (block size crossing a leaf's
+      threshold): keys the target lacks are dropped, keys it gained start
+      at zero.
+    """
+    if not isinstance(raw, dict):
+        return raw
+    target_sd = serialization.to_state_dict(host_target)
+    if "residuals" not in target_sd:
+        return raw
+    target_res = target_sd.get("residuals") or {}
+    saved_res = raw.get("residuals") or {}
+    if not isinstance(saved_res, dict):
+        saved_res = {}
+    target_params = target_sd.get("params", {})
+
+    def _leaf_elements(key: str) -> int | None:
+        node: Any = target_params
+        for part in key.split("/"):
+            if not isinstance(node, dict) or part not in node:
+                return None
+            node = node[part]
+        return int(np.asarray(node).size)
+
+    out = {}
+    for key, like in target_res.items():
+        like = np.asarray(like)
+        saved = saved_res.get(key)
+        n = _leaf_elements(key)
+        if saved is None or n is None:
+            out[key] = np.zeros(like.shape, like.dtype)
+            continue
+        out[key] = _relayout_residual_leaf(np.asarray(saved), like, n)
+    raw = dict(raw)
+    raw["residuals"] = out
+    return raw
+
+
+def _relayout_residual_leaf(saved: np.ndarray, like: np.ndarray,
+                            n: int) -> np.ndarray:
+    """Reshard one residual leaf onto ``like``'s ``[world, qpad]`` layout.
+
+    ``n`` is the true element count of the matching params leaf (both
+    layouts pad per 1/world chunk — `collectives.psum_scatter_quant`'s
+    layout discipline — so the remap goes through the unpadded leaf
+    order). Same shape passes through bitwise; otherwise the rows are
+    summed (the total pending correction), un-padded chunk-wise from the
+    old world's layout, re-padded into the new world's, and assigned to
+    replica 0's row.
+    """
+    from tpu_dp.parallel.collectives import shard_size
+
+    if saved.shape == like.shape:
+        return saved.astype(like.dtype)
+    if saved.ndim != 2 or like.ndim != 2:
+        return np.zeros(like.shape, like.dtype)
+    w_old = saved.shape[0]
+    cpad_old = saved.shape[1] // max(1, w_old)
+    pchunk_old = shard_size(n, w_old)
+    pending = saved.sum(axis=0).reshape(w_old, cpad_old)[:, :pchunk_old]
+    pending = pending.reshape(-1)[:n]
+    w_new = like.shape[0]
+    cpad_new = like.shape[1] // max(1, w_new)
+    pchunk_new = shard_size(n, w_new)
+    rows = np.zeros((w_new, cpad_new), like.dtype)
+    padded = np.zeros(w_new * pchunk_new, like.dtype)
+    padded[:n] = pending
+    rows[:, :pchunk_new] = padded.reshape(w_new, pchunk_new)
+    out = np.zeros(like.shape, like.dtype)
+    out[0] = rows.reshape(-1)
+    return out
+
+
 def load_checkpoint(
     ckpt_dir: str | os.PathLike, target: TrainState
 ) -> tuple[TrainState, dict[str, Any]]:
@@ -188,13 +281,18 @@ def load_checkpoint(
     checkpoint was written under a different topology or
     ``train.update_sharding`` mode (`_relayout_opt_leaf`) — a run killed on
     8 chips resumes on 4, and a replicated checkpoint upgrades to the
-    sharded update in place.
+    sharded update in place. The int8 wire codec's error-feedback
+    residuals ride the same path (`_reconcile_residuals`): same-layout
+    restores are exact, world/block-size changes preserve the total
+    pending correction, checkpoints predating the codec load with
+    zero-initialized residuals.
     """
     ckpt_dir = Path(ckpt_dir)
     payload = (ckpt_dir / _CKPT_NAME).read_bytes()
     host_target = _to_host(target)
     raw = serialization.msgpack_restore(payload)
     raw = _maybe_reshard_opt_state(raw, host_target)
+    raw = _reconcile_residuals(raw, host_target)
     state = serialization.from_state_dict(host_target, raw)
     meta_path = ckpt_dir / _META_NAME
     meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}

@@ -119,6 +119,19 @@ class FlightRecorder:
         self.enabled = True
         self.rank = int(rank)
         self.dump_dir = None if dump_dir is None else Path(dump_dir)
+        if fresh and self.dump_dir is not None:
+            # A dump_request.json left behind by a PREVIOUS incarnation (a
+            # hang that got the job killed before the sentinel aged out)
+            # must not fire on THIS run's first window — the near-empty new
+            # ring would overwrite the very flightrec_r*.json dumps the
+            # sentinel existed to preserve. Prime the handled mark with the
+            # stale sentinel's mtime; only a request written AFTER this run
+            # started is honored.
+            try:
+                self._req_handled = (
+                    self.dump_dir / DUMP_REQUEST).stat().st_mtime
+            except OSError:
+                pass
         if run is not None:
             self.run = dict(run)
         if capacity is not None and int(capacity) != self.capacity:

@@ -18,8 +18,10 @@ work never double-counts — into:
   generations, with evictions/rollbacks/regroups as instant-event
   markers;
 - ``diff``        — a regression verdict of the run's mfu / goodput /
-  p95 step time against a ``BENCH_*.json`` baseline, exit-coded so CI
-  can gate on it (``--write-baseline`` mints a baseline from a run).
+  p95 step time — and, for quantized-collective runs, the int8 codec's
+  quant.overflow / quant.clip_blocks as per-step rates — against a
+  ``BENCH_*.json`` baseline, exit-coded so CI can gate on it
+  (``--write-baseline`` mints a baseline from a run).
 
 Run it as ``python -m tpu_dp.obs <cmd> <run_dir>`` or
 ``tools/obsctl.py``; ``run_dir`` is the training run's checkpoint root
@@ -387,15 +389,54 @@ def build_timeline(art: RunArtifacts, include_steps: bool = False) -> dict:
 # efficiency extraction + diff
 # --------------------------------------------------------------------------
 
+def _quant_counters(metrics: list[dict]) -> dict:
+    """The run's int8-codec health as PER-STEP rates, from its records'
+    counter snapshots (``quant.overflow`` / ``quant.clip_blocks``,
+    published by the trainer's per-window fetch).
+
+    The registry counters are run-cumulative, so comparing them raw
+    against a BENCH baseline (counts over its few latency steps) would
+    make every longer-than-bench run a spurious regression — both sides
+    normalize to blocks per optimizer step instead (`load_baseline`
+    divides the BENCH totals by its ``stats_steps``). The divisor is the
+    last counter-carrying record's global step — approximate when
+    publishing started mid-run, exact for the zero-overflow gate either
+    way (0/N == 0). None when the run never published them — a
+    non-quantized run must diff exactly as before, never "0"."""
+    overflow = clip = None
+    steps = 0
+    for r in metrics:
+        counters = r.get("counters")
+        if not isinstance(counters, dict):
+            continue
+        if "quant.overflow" in counters:
+            overflow = counters["quant.overflow"]
+            steps = max(steps, int(r.get("step", 0)))
+        if "quant.clip_blocks" in counters:
+            clip = counters["quant.clip_blocks"]
+            steps = max(steps, int(r.get("step", 0)))
+    steps = max(steps, 1)
+    return {
+        "quant_overflow_per_step": (
+            None if overflow is None else round(overflow / steps, 4)),
+        "quant_clip_blocks_per_step": (
+            None if clip is None else round(clip / steps, 4)),
+    }
+
+
 def run_efficiency(art: RunArtifacts) -> dict:
-    """The run's {mfu, goodput, p95_ms} from its metrics stream.
+    """The run's {mfu, goodput, p95_ms, quant_*} from its metrics stream.
 
     Prefers the epoch records' ``efficiency`` rollups (schema 3, written
     by the live accounting); falls back to recomputing from per-step
     span records (obs=full runs predating the rollup, or partial runs).
     Missing signals are None — `diff` compares only what both sides have.
+    The int8 codec's overflow/clip counts (when the run published them)
+    ride along so a quantization-quality regression is CI-gateable like
+    mfu/goodput.
     """
     metrics = sweep_rollback_generations(art.metrics())
+    quant = _quant_counters(metrics)
     eff_recs = [r["efficiency"] for r in metrics
                 if "epoch" in r and isinstance(r.get("efficiency"), dict)]
     if eff_recs:
@@ -405,12 +446,13 @@ def run_efficiency(art: RunArtifacts) -> dict:
             "goodput": last.get("goodput"),
             "p95_ms": (last.get("step_time_ms") or {}).get("p95"),
             "source": "epoch_efficiency_rollup",
+            **quant,
         }
     per_step = [r for r in metrics
                 if "spans" in r and "event" not in r and "epoch" not in r]
     if not per_step:
         return {"mfu": None, "goodput": None, "p95_ms": None,
-                "source": "none"}
+                "source": "none", **quant}
     totals, waits, mfus, goodputs = [], [], [], []
     for r in per_step:
         spans = r["spans"]
@@ -429,17 +471,33 @@ def run_efficiency(art: RunArtifacts) -> dict:
         ),
         "p95_ms": round(percentile(sorted(totals), 95), 3),
         "source": "per_step_spans",
+        **quant,
     }
 
 
 def load_baseline(path: Path) -> dict:
-    """{mfu, goodput, p95_ms} out of a BENCH_*.json (or obsctl baseline)."""
+    """{mfu, goodput, p95_ms, quant_*_per_step} out of a BENCH_*.json (or
+    obsctl baseline). Quant rates come from the baseline's own per-step
+    keys, or from a BENCH record's ``quant`` block — whose overflow /
+    clip_blocks totals cover ``stats_steps`` fenced steps and are
+    normalized here so run and baseline always compare in the same unit
+    (blocks per optimizer step)."""
     rec = json.loads(path.read_text())
     latency = rec.get("latency") or {}
+    quant = rec.get("quant") or {}
+    q_steps = max(int(quant.get("stats_steps", 0) or 0), 1)
+
+    def rate(total):
+        return None if total is None else round(total / q_steps, 4)
+
     return {
         "mfu": rec.get("mfu"),
         "goodput": rec.get("goodput"),
         "p95_ms": rec.get("p95_ms", latency.get("p95_ms")),
+        "quant_overflow_per_step": rec.get(
+            "quant_overflow_per_step", rate(quant.get("overflow"))),
+        "quant_clip_blocks_per_step": rec.get(
+            "quant_clip_blocks_per_step", rate(quant.get("clip_blocks"))),
     }
 
 
@@ -447,14 +505,19 @@ def diff_verdict(run: dict, base: dict, tolerance: float) -> dict:
     """Per-signal verdicts + the overall regression flag.
 
     Lower-is-worse signals (mfu, goodput) regress below
-    ``base x (1 - tolerance)``; higher-is-worse (p95_ms) above
-    ``base x (1 + tolerance)``. Signals missing on either side are
-    reported ``skipped`` — absence of evidence is surfaced, never
-    silently passed.
+    ``base x (1 - tolerance)``; higher-is-worse (p95_ms, and the int8
+    codec's per-step quant_overflow / quant_clip_blocks rates) above
+    ``base x (1 + tolerance)`` — with a zero-rate baseline that bound is
+    zero, so ANY overflow where the baseline had none is a regression
+    (exactly right: overflow means non-finite blocks entered the codec).
+    Signals missing on either side are reported ``skipped`` — absence of
+    evidence is surfaced, never silently passed.
     """
     checks = []
     for key, worse_is_lower in (("mfu", True), ("goodput", True),
-                                ("p95_ms", False)):
+                                ("p95_ms", False),
+                                ("quant_overflow_per_step", False),
+                                ("quant_clip_blocks_per_step", False)):
         r, b = run.get(key), base.get(key)
         if r is None or b is None:
             checks.append({"signal": key, "verdict": "skipped",
@@ -623,6 +686,9 @@ def cmd_diff(args) -> int:
             "mfu": run["mfu"],
             "goodput": run["goodput"],
             "p95_ms": run["p95_ms"],
+            "quant_overflow_per_step": run.get("quant_overflow_per_step"),
+            "quant_clip_blocks_per_step": run.get(
+                "quant_clip_blocks_per_step"),
             "source_run": str(art.run_dir),
             "source": run["source"],
         }

@@ -20,6 +20,16 @@ the one non-trivial piece of that decomposition: flattening + zero-padding
 every leaf to a multiple of the axis size, so leaves whose element counts do
 not divide the mesh (CIFAR `Net`'s f32[5,5,3,6] on 8 chips) shard exactly
 like the rest, and un-padding on the gather side.
+
+The wire format of both ring halves is pluggable (`tpu_dp.parallel.quant`):
+``psum_scatter(dtype=bf16)`` casts the payload (PR 4's knob, 2x fewer
+bytes), and `psum_scatter_quant` is the blockwise-scaled **int8** wire
+(EQuARX, arXiv:2506.17615; `train.collective_dtype=int8`) — quantize once
+before the exchange, ONE int8 all-to-all (+f32 scales) instead of the f32
+reduce-scatter, dequantize-and-sum once after, with per-sender
+error-feedback residuals so rounding bias cannot accumulate. This module
+owns every raw collective (the dplint DP103 choke point); the codec math
+lives in `quant.py`.
 """
 
 from __future__ import annotations
@@ -111,6 +121,114 @@ def psum_scatter(
     return jax.tree_util.tree_map(scatter, tree)
 
 
+def psum_scatter_quant(
+    tree: Any,
+    residuals: dict,
+    axis_name: str = DATA_AXIS,
+    *,
+    world: int,
+    mean: bool = False,
+    block_size: int | None = None,
+    error_feedback: bool = True,
+) -> tuple[Any, dict, dict]:
+    """Reduce-scatter with a blockwise-scaled **int8 wire format**.
+
+    The EQuARX-style compressed collective (`train.collective_dtype=int8`;
+    `tpu_dp.parallel.quant` holds the codec, this wrapper owns the wire
+    schedule — the DP103 choke-point discipline). Per quantizable leaf:
+
+    1. **error feedback**: this replica's pending rounding error
+       (``residuals``, per-replica row of the flat-sharded residual state)
+       is added to the local flat-padded gradient;
+    2. **quantize once** (`quant.quantize_blocks`): int8 payload + one f32
+       scale per ``block_size`` elements; the new residual is the exact
+       rounding error of what goes on the wire;
+    3. **exchange**: ONE int8 `all_to_all` over the data axis (plus the
+       f32 scales riding alongside) — the same traffic pattern as a
+       reduce-scatter's scatter phase, at ~1/4 the bytes. XLA cannot sum
+       int8 payloads under per-replica scales, so the reduction is
+       explicit: each replica dequantizes the ``world`` chunks it received
+       and sums them in f32 — *dequantize once*, per Xu et al.'s schedule;
+    4. the summed 1/world shard is trimmed to `psum_scatter`'s layout
+       (``shard_size(n, world)`` elements), ``mean=True`` divides by
+       ``world`` after the reduce, exactly like the f32 path.
+
+    Leaves too small to block-align (`quant.leaf_quantizes` False — biases,
+    norm scales) ride the plain f32 `psum_scatter`; they carry no residual.
+
+    Returns ``(shards, new_residuals, stats)``: shards in `psum_scatter`'s
+    flat layout, the updated residual pytree (same structure as
+    ``residuals``), and ``stats`` with **rank-local** s32 ``overflow`` /
+    ``clip`` block counts (`quant.block_stats`) — the caller reduces them
+    (the step's reduce hook psums, like the other metrics).
+    ``error_feedback=False`` is the ablation seam: residuals are neither
+    read nor updated (fed in as zeros, emitted unchanged), isolating what
+    the residual path buys (tests/test_quant.py proves it is measurably
+    worse without).
+    """
+    from tpu_dp.parallel import quant
+
+    if block_size is None:
+        block_size = quant.DEFAULT_BLOCK_SIZE
+    overflow = jnp.zeros((), jnp.int32)
+    clip = jnp.zeros((), jnp.int32)
+    new_residuals = dict(residuals)
+
+    def scatter_leaf(path, x):
+        nonlocal overflow, clip
+        key = quant.leaf_key(path)
+        if key not in residuals:
+            # Small-leaf fallback: the uncompressed scatter.
+            return psum_scatter(
+                x, axis_name, world=world, mean=mean
+            )
+        out_dtype = x.dtype
+        res = residuals[key].reshape(-1)  # per-replica row -> flat [qpad]
+        qpad = res.shape[0]
+        # Layout discipline: the reduced shard must land in EXACTLY
+        # `psum_scatter`'s flat layout (replica i owns elements
+        # [i*pchunk, (i+1)*pchunk) of the world-padded leaf) — the sharded
+        # optimizer pairs it positionally with `shard_slice`'s param
+        # shards. So the block-alignment padding goes at the tail of EACH
+        # chunk, never the tail of the flat vector: chunk boundaries stay
+        # where the f32 path puts them, and every chunk is a whole number
+        # of blocks (world * cpad == quant_padded_size, both f32-zero in
+        # the pad region).
+        pchunk = shard_size(x.size, world)
+        cpad = qpad // world
+        rows = _flat_padded(x, world).astype(jnp.float32).reshape(
+            world, pchunk
+        )
+        rows = jnp.pad(rows, ((0, 0), (0, cpad - pchunk)))
+        eff = rows.reshape(-1)
+        if error_feedback:
+            eff = eff + res
+        q, scales = quant.quantize_blocks(eff, block_size)
+        if error_feedback:
+            deq_local = quant.dequantize_blocks(q, scales, block_size)
+            new_residuals[key] = (eff - deq_local).reshape(1, qpad)
+        ov, cl = quant.block_stats(q, scales)
+        overflow, clip = overflow + ov, clip + cl
+        qx = lax.all_to_all(
+            q.reshape(world, cpad), axis_name,
+            split_axis=0, concat_axis=0, tiled=True,
+        )
+        sx = lax.all_to_all(
+            scales.reshape(world, cpad // block_size), axis_name,
+            split_axis=0, concat_axis=0, tiled=True,
+        )
+        deq = (qx.reshape(world, cpad // block_size, block_size)
+               .astype(jnp.float32) * sx[..., None])
+        shard = jnp.sum(deq, axis=0).reshape(cpad)
+        shard = shard[:pchunk].astype(out_dtype)
+        if mean:
+            shard = shard / world
+        return shard
+
+    shards = jax.tree_util.tree_map_with_path(scatter_leaf, tree)
+    return shards, new_residuals, {"overflow": overflow, "clip": clip}
+
+
 def shard_slice(tree: Any, axis_name: str = DATA_AXIS, *, world: int) -> Any:
     """This replica's 1/world flat shard of every (replicated) leaf.
 
@@ -129,17 +247,61 @@ def shard_slice(tree: Any, axis_name: str = DATA_AXIS, *, world: int) -> Any:
     return jax.tree_util.tree_map(slice_leaf, tree)
 
 
-def all_gather(shards: Any, like: Any, axis_name: str = DATA_AXIS) -> Any:
+def all_gather(shards: Any, like: Any, axis_name: str = DATA_AXIS,
+               *, codec: Any = None) -> Any:
     """Reassemble flat 1/world shards into leaves shaped like ``like``.
 
     The second ring half of the decomposed all-reduce: concatenate every
     replica's shard (tiled all-gather), drop the zero padding, restore the
     original shape/dtype. `all_gather(psum_scatter(t, mean=True), t)` is
     numerically `pmean(t)` — the parity test asserts it bitwise for f32.
+
+    ``codec`` compresses the gather's wire format the same way the scatter
+    side compresses (`quant.CastCodec` casts, `quant.Int8BlockCodec`
+    quantizes each shard blockwise and dequantizes after the exchange —
+    stateless here: there is no residual on the gather side). The shipped
+    train path deliberately does NOT enable it: the gathered payload is
+    the *updated parameters*, so wire rounding there would quantize the
+    weights themselves every step rather than one gradient contribution —
+    a different accuracy contract than the EQuARX gradient compression
+    this PR lands (documented in docs/PERF.md; the knob exists so the
+    trade can be measured).
     """
+    from tpu_dp.parallel import quant
 
     def gather(shard, ref):
         full = lax.all_gather(shard, axis_name, axis=0, tiled=True)
         return full[: ref.size].reshape(ref.shape).astype(ref.dtype)
 
-    return jax.tree_util.tree_map(gather, shards, like)
+    if codec is None:
+        return jax.tree_util.tree_map(gather, shards, like)
+
+    if isinstance(codec, quant.CastCodec):
+        def gather_cast(shard, ref):
+            full = lax.all_gather(
+                shard.astype(codec.dtype), axis_name, axis=0, tiled=True
+            )
+            return full[: ref.size].reshape(ref.shape).astype(ref.dtype)
+
+        return jax.tree_util.tree_map(gather_cast, shards, like)
+
+    if isinstance(codec, quant.Int8BlockCodec):
+        block = codec.block_size
+
+        def gather_q(shard, ref):
+            flat = shard.reshape(-1).astype(jnp.float32)
+            pad = (-flat.size) % block
+            padded = jnp.pad(flat, (0, pad))
+            q, scales = quant.quantize_blocks(padded, block)
+            qx = lax.all_gather(q, axis_name, axis=0, tiled=True)
+            sx = lax.all_gather(scales, axis_name, axis=0, tiled=True)
+            full = quant.dequantize_blocks(qx, sx, block)
+            # Drop each replica's block padding, then the shard padding.
+            full = full.reshape(-1, flat.size + pad)[:, : flat.size]
+            return full.reshape(-1)[: ref.size].reshape(ref.shape).astype(
+                ref.dtype
+            )
+
+        return jax.tree_util.tree_map(gather_q, shards, like)
+
+    raise TypeError(f"unknown wire codec {codec!r}")

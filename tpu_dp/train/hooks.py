@@ -352,7 +352,10 @@ class GuardHook(StepHook):
         first = tr._host_step - ev.n + 1
         # The guard's fence: one fetch of 3 scalars per window step. This
         # is the only host sync guardrails add (measured by
-        # `bench.py --guard-overhead`).
+        # `bench.py --guard-overhead`). The int8 codec's overflow/clip
+        # counts ride the same fence into the counter registry (no-op and
+        # deduped when obs=full already published this window).
+        tr._publish_quant_counters(ev.window, first)
         records = []
         for k, m in enumerate(ev.window):
             records.append({

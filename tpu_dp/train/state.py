@@ -24,6 +24,13 @@ class TrainState:
     params: Any
     opt_state: Any
     batch_stats: Any  # {} for models without BatchNorm (e.g. `Net`)
+    # Error-feedback residuals of the int8 wire codec
+    # (`train.collective_dtype=int8`; tpu_dp/parallel/quant.py): per
+    # quantized leaf, each replica's pending rounding error —
+    # f32[world, quant_padded_size], flat-sharded over the data axis like
+    # the opt state. {} (zero leaves) everywhere the codec is off, so
+    # every pre-existing program's pytree is unchanged.
+    residuals: Any = flax.struct.field(default_factory=dict)
 
     @property
     def has_batch_stats(self) -> bool:

@@ -153,7 +153,7 @@ def _forward_backward(model, loss_impl, state: TrainState, images, labels,
 
 def _apply_update(
     optimizer: Optimizer, schedule: Schedule, state: TrainState, grads,
-    new_batch_stats, lr_scale=None,
+    new_batch_stats, lr_scale=None, new_residuals=None,
 ):
     """Shared optimizer tail: LR lookup, update, next TrainState.
 
@@ -163,6 +163,11 @@ def _apply_update(
     re-trace the exact trajectory that diverged. None (the default, every
     non-sentinel program) leaves the schedule untouched — and the trace
     unchanged.
+
+    ``new_residuals`` carries the int8 wire codec's updated error-feedback
+    state out of the reduce hook (None — every non-quantized program —
+    passes the state's residuals through untouched: {} for them, so the
+    compiled HLO is unchanged).
     """
     lr = schedule(state.step)
     if lr_scale is not None:
@@ -175,6 +180,8 @@ def _apply_update(
         params=new_params,
         opt_state=new_opt_state,
         batch_stats=new_batch_stats,
+        residuals=(state.residuals if new_residuals is None
+                   else new_residuals),
     )
     return new_state, lr
 
@@ -273,7 +280,8 @@ def _grad_health(grads, loss, health_reduce=None):
 
 def _sentinel_tail(optimizer, schedule, state, grads, new_batch_stats,
                    loss, correct, count, guard_in, health_reduce,
-                   opt_pred_cast=None):
+                   opt_pred_cast=None, new_residuals=None,
+                   extra_metrics=None):
     """The sentinel step tail: health summary → guarded update → metrics.
 
     The update is computed unconditionally and then *selected against*: a
@@ -299,13 +307,16 @@ def _sentinel_tail(optimizer, schedule, state, grads, new_batch_stats,
     with jax.named_scope("tpu_dp.update"):
         new_state, lr = _apply_update(
             optimizer, schedule, state, grads, new_batch_stats,
-            lr_scale=guard_in["lr_scale"],
+            lr_scale=guard_in["lr_scale"], new_residuals=new_residuals,
         )
         # ``opt_pred_cast`` (sharded update only): the opt-state leaves
         # are device-varying 1/world shards under shard_map's replication
         # typing, so the invariant skip predicate is cast varying for that
         # subtree (`_to_varying`; a no-op on pre-vma JAX and everywhere
-        # else the whole state is replicated).
+        # else the whole state is replicated). The int8 codec's residuals
+        # share the varying predicate: a quarantined batch's quantization
+        # error must be forgotten WITH the batch, or the next step's error
+        # feedback would re-inject a slice of the poisoned gradient.
         opt_pred = applied if opt_pred_cast is None else opt_pred_cast(applied)
         new_state = TrainState(
             step=jnp.where(applied, new_state.step, state.step),
@@ -318,6 +329,9 @@ def _sentinel_tail(optimizer, schedule, state, grads, new_batch_stats,
             batch_stats=jax.tree_util.tree_map(
                 lambda n, o: jnp.where(applied, n, o),
                 new_state.batch_stats, state.batch_stats),
+            residuals=jax.tree_util.tree_map(
+                lambda n, o: jnp.where(opt_pred, n, o),
+                new_state.residuals, state.residuals),
         )
     metrics = {
         "loss": jnp.where(applied, loss, jnp.zeros_like(loss)),
@@ -328,6 +342,8 @@ def _sentinel_tail(optimizer, schedule, state, grads, new_batch_stats,
         "grad_norm": gnorm,
         "applied": applied.astype(jnp.int32),
     }
+    if extra_metrics:
+        metrics.update(extra_metrics)
     return new_state, metrics
 
 
@@ -339,11 +355,15 @@ def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn,
     normalize → augment → fwd/bwd → [cross-replica reduce] → update →
     metrics, so the host-loop and device-loop paths cannot drift apart.
 
-    ``reduce_fn(grads, loss, correct, count, batch_stats)`` is the
-    explicit-collectives hook: the GSPMD path passes None (the partitioner
-    infers the gradient all-reduce from shardings), the `shard_map` path
-    injects the typed collective wrappers between the per-shard grads and
-    the optimizer update — the one placement `tpu_dp.analysis` verifies.
+    ``reduce_fn(grads, loss, correct, count, batch_stats, residuals)`` is
+    the explicit-collectives hook: the GSPMD path passes None (the
+    partitioner infers the gradient all-reduce from shardings), the
+    `shard_map` path injects the typed collective wrappers between the
+    per-shard grads and the optimizer update — the one placement
+    `tpu_dp.analysis` verifies. It returns the reduced values plus the
+    (possibly updated) error-feedback residuals and an extra-metrics dict
+    ({} everywhere but the int8 wire codec, whose overflow/clip counts
+    ride the metrics stream).
 
     ``sentinel=True`` (the guardrail layer, docs/RESILIENCE.md
     "Guardrails") adds the on-device health summary + guarded update
@@ -373,20 +393,25 @@ def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn,
         if sentinel:
             gi = guard_in if guard_in is not None else default_guard_in()
             loss, grads = _inject_guard_fault(state.step, loss, grads, gi)
+        new_residuals, extra = None, {}
         if reduce_fn is not None:
             with jax.named_scope("tpu_dp.grad_reduce"):
-                grads, loss, correct, count, new_batch_stats = reduce_fn(
-                    grads, loss, correct, count, new_batch_stats
+                (grads, loss, correct, count, new_batch_stats,
+                 new_residuals, extra) = reduce_fn(
+                    grads, loss, correct, count, new_batch_stats,
+                    state.residuals,
                 )
         if sentinel:
             return _sentinel_tail(
                 optimizer, schedule, state, grads, new_batch_stats,
                 loss, correct, count, guard_in, health_reduce,
-                opt_pred_cast=opt_pred_cast,
+                opt_pred_cast=opt_pred_cast, new_residuals=new_residuals,
+                extra_metrics=extra,
             )
         with jax.named_scope("tpu_dp.update"):
             new_state, lr = _apply_update(
-                optimizer, schedule, state, grads, new_batch_stats
+                optimizer, schedule, state, grads, new_batch_stats,
+                new_residuals=new_residuals,
             )
         metrics = {
             "loss": loss,
@@ -394,6 +419,7 @@ def _make_step_body(model, optimizer, schedule, loss_impl, augment_fn,
             "count": count,
             "lr": lr,
         }
+        metrics.update(extra)
         return new_state, metrics
 
     return body
@@ -467,22 +493,29 @@ def _make_accum_body(
 
         # The reduce hook sits AFTER the microbatch scan and the 1/accum
         # rescale: exactly one cross-replica reduction per optimizer update,
-        # never one per microbatch (`tpu_dp.analysis` DP202 verifies this).
+        # never one per microbatch (`tpu_dp.analysis` DP202 verifies this)
+        # — and so the int8 codec quantizes (and its residual updates) once
+        # per optimizer update too.
+        new_residuals, extra = None, {}
         if reduce_fn is not None:
             with jax.named_scope("tpu_dp.grad_reduce"):
-                grads, loss, correct, count, new_batch_stats = reduce_fn(
-                    grads, loss, correct, count, new_batch_stats
+                (grads, loss, correct, count, new_batch_stats,
+                 new_residuals, extra) = reduce_fn(
+                    grads, loss, correct, count, new_batch_stats,
+                    state.residuals,
                 )
 
         if sentinel:
             return _sentinel_tail(
                 optimizer, schedule, state, grads, new_batch_stats,
                 loss, correct, count, guard_in, health_reduce,
-                opt_pred_cast=opt_pred_cast,
+                opt_pred_cast=opt_pred_cast, new_residuals=new_residuals,
+                extra_metrics=extra,
             )
         with jax.named_scope("tpu_dp.update"):
             new_state, lr = _apply_update(
-                optimizer, schedule, state, grads, new_batch_stats
+                optimizer, schedule, state, grads, new_batch_stats,
+                new_residuals=new_residuals,
             )
         metrics = {
             "loss": loss,
@@ -490,6 +523,7 @@ def _make_accum_body(
             "count": count,
             "lr": lr,
         }
+        metrics.update(extra)
         return new_state, metrics
 
     return body
@@ -577,6 +611,8 @@ def make_multi_step(
     accum_steps: int = 1,
     update_sharding: str = "replicated",
     collective_dtype: str | None = None,
+    quant_block_size: int | None = None,
+    quant_error_feedback: bool = True,
     sentinel: bool = False,
 ) -> Callable:
     """Device-side training loop: ``num_steps`` train steps in ONE program.
@@ -632,6 +668,8 @@ def make_multi_step(
             world=data_axis_size(mesh), axis_name=DATA_AXIS,
             update_sharding=update_sharding,
             collective_dtype=collective_dtype,
+            quant_block_size=quant_block_size,
+            quant_error_feedback=quant_error_feedback,
             sentinel=sentinel,
         )
     else:
@@ -697,6 +735,8 @@ def make_multi_step_resident(
     accum_steps: int = 1,
     update_sharding: str = "replicated",
     collective_dtype: str | None = None,
+    quant_block_size: int | None = None,
+    quant_error_feedback: bool = True,
     sentinel: bool = False,
 ) -> Callable:
     """Windowed training loop fed by a device-resident dataset + indices.
@@ -737,6 +777,8 @@ def make_multi_step_resident(
             world=data_axis_size(mesh), axis_name=DATA_AXIS,
             update_sharding=update_sharding,
             collective_dtype=collective_dtype,
+            quant_block_size=quant_block_size,
+            quant_error_feedback=quant_error_feedback,
             sentinel=sentinel,
         )
     else:
@@ -810,18 +852,25 @@ def _check_update_sharding(update_sharding: str, optimizer) -> None:
         )
 
 
-def _parse_collective_dtype(collective_dtype: str | None):
-    """`train.collective_dtype` → jnp dtype for the wire format (or None)."""
-    if not collective_dtype:
-        return None
-    allowed = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
-               "f32": None, "float32": None}
-    if collective_dtype not in allowed:
-        raise ValueError(
-            f"collective_dtype must be one of {sorted(allowed)} (or empty), "
-            f"got {collective_dtype!r}"
-        )
-    return allowed[collective_dtype]
+def _parse_wire_codec(collective_dtype: str | None,
+                      quant_block_size: int | None = None,
+                      quant_error_feedback: bool = True):
+    """`train.collective_dtype` → wire codec for the gradient collective.
+
+    The cast-only knob of PR 4 grown into a pluggable codec seam
+    (`tpu_dp.parallel.quant.make_wire_codec`): None/"f32" keeps the leaf
+    dtype on the wire, "bf16" returns the cast codec, "int8" the
+    blockwise-absmax-scaled codec with error feedback — which is the one
+    that needs the residual state threaded through `TrainState`.
+    """
+    from tpu_dp.parallel import quant
+
+    return quant.make_wire_codec(
+        collective_dtype,
+        block_size=(quant.DEFAULT_BLOCK_SIZE if quant_block_size is None
+                    else quant_block_size),
+        error_feedback=quant_error_feedback,
+    )
 
 
 def _state_specs(update_sharding: str):
@@ -840,8 +889,12 @@ def _state_specs(update_sharding: str):
 
     if update_sharding != "sharded":
         return P()
+    # Residuals (int8 wire codec) are flat-sharded like the opt state:
+    # f32[world, qpad] leaves with dim 0 over the data axis — each replica
+    # holds its own pending-rounding-error row. {} when the codec is off,
+    # where the prefix spec binds zero leaves.
     return TrainState(step=P(), params=P(), opt_state=P(DATA_AXIS),
-                      batch_stats=P())
+                      batch_stats=P(), residuals=P(DATA_AXIS))
 
 
 def _state_shardings(mesh: Mesh, update_sharding: str):
@@ -858,6 +911,7 @@ def _state_shardings(mesh: Mesh, update_sharding: str):
         step=repl, params=repl,
         opt_state=NamedSharding(mesh, P(DATA_AXIS)),
         batch_stats=repl,
+        residuals=NamedSharding(mesh, P(DATA_AXIS)),
     )
 
 
@@ -873,6 +927,8 @@ def make_local_step(
     cast_params: bool = True,
     update_sharding: str = "replicated",
     collective_dtype: str | None = None,
+    quant_block_size: int | None = None,
+    quant_error_feedback: bool = True,
     sentinel: bool = False,
 ) -> Callable:
     """The per-shard step program with *explicit* collectives, unjitted.
@@ -896,8 +952,16 @@ def make_local_step(
     (`reduce_scatter` counts as the data-axis reduction for DP201/DP202);
     the compiled schedule becomes one reduce-scatter group + one all-gather
     group instead of one all-reduce group (DP301's second legal schedule).
-    ``collective_dtype`` (e.g. "bf16") compresses the reduce-scatter wire
-    format, EQuARX-style — off (None/"") reduces in the leaf dtype.
+    ``collective_dtype`` compresses the reduce-scatter wire format,
+    EQuARX-style — off (None/"") reduces in the leaf dtype, "bf16" casts
+    the payload, "int8" routes quantizable leaves through the blockwise-
+    scaled codec (`collectives.psum_scatter_quant`): quantize once (with
+    the ``TrainState.residuals`` error feedback, unless
+    ``quant_error_feedback=False`` — the ablation seam), ONE int8
+    all-to-all + f32 scales on the wire, dequantize-and-sum once; DP301's
+    third legal schedule. ``quant_block_size`` sets the scaling-block
+    length (`train.quant_block_size`; None = 256), and the step's metrics
+    gain replicated ``quant_overflow``/``quant_clip`` block counts.
 
     Exposed as a factory (rather than a closure inside the shard_map
     wrapper) so `tpu_dp.analysis` can trace the *real shipped program* on
@@ -910,30 +974,52 @@ def make_local_step(
     pre-vma JAX anyway); the analyzer uses it to trace outside a real
     `shard_map` scope.
     """
-    from tpu_dp.parallel import collectives
+    from tpu_dp.parallel import collectives, quant
     from tpu_dp.parallel.dist import DATA_AXIS
 
     if axis_name is None:
         axis_name = DATA_AXIS
     _check_update_sharding(update_sharding, optimizer)
-    wire_dtype = _parse_collective_dtype(collective_dtype)
-    if wire_dtype is not None and update_sharding != "sharded":
-        # Only the sharded reduce-scatter reads the wire dtype; accepting
+    codec = _parse_wire_codec(collective_dtype, quant_block_size,
+                              quant_error_feedback)
+    if codec is not None and update_sharding != "sharded":
+        # Only the sharded reduce-scatter reads the wire codec; accepting
         # it here would silently run full-precision pmean instead.
         raise ValueError(
             "collective_dtype applies to the sharded update's "
             "reduce-scatter; pass update_sharding='sharded'"
         )
+
     loss_impl = _select_loss_impl(use_pallas_xent)
 
-    def reduce_fn(grads, loss, correct, count, batch_stats):
+    def reduce_fn(grads, loss, correct, count, batch_stats, residuals):
         # The explicit DDP reduction: grad mean over the data axis, exactly
         # once, after any gradient-accumulation scan. Replicated mode
         # all-reduces the full leaves; sharded mode reduce-scatters, each
-        # replica keeping only the shard its optimizer slice will consume.
-        if update_sharding == "sharded":
+        # replica keeping only the shard its optimizer slice will consume —
+        # through the int8 wire codec when configured (quantize once →
+        # int8 all-to-all → dequantize once; residuals carry the error
+        # feedback across steps).
+        extra = {}
+        if isinstance(codec, quant.Int8BlockCodec):
+            grads, residuals, stats = collectives.psum_scatter_quant(
+                grads, residuals, axis_name, world=world, mean=True,
+                block_size=codec.block_size,
+                error_feedback=codec.error_feedback,
+            )
+            # Codec-health counts are rank-local (each replica quantizes
+            # its own contribution): two scalar psums make them replicated
+            # metrics — declared in the analyzer's metric-reduction budget
+            # for the int8 programs, like loss/correct.
+            extra = {
+                "quant_overflow": collectives.psum(
+                    stats["overflow"], axis_name),
+                "quant_clip": collectives.psum(stats["clip"], axis_name),
+            }
+        elif update_sharding == "sharded":
             grads = collectives.psum_scatter(
-                grads, axis_name, world=world, mean=True, dtype=wire_dtype
+                grads, axis_name, world=world, mean=True,
+                dtype=codec.dtype if codec is not None else None,
             )
         else:
             grads = collectives.pmean(grads, axis_name)
@@ -946,7 +1032,7 @@ def make_local_step(
             # axis_name=DATA_AXIS already synced in-forward — skip the
             # redundant per-step all-reduce over the stats tree.
             batch_stats = collectives.pmean(batch_stats, axis_name)
-        return grads, loss, correct, count, batch_stats
+        return grads, loss, correct, count, batch_stats, residuals, extra
 
     # Mark the replicated params as device-varying before differentiating.
     # Under shard_map's replication typing, grads of a *varying* loss wrt
@@ -985,6 +1071,8 @@ def make_train_step_shard_map(
     augment_fn: Callable | None = None,
     update_sharding: str = "replicated",
     collective_dtype: str | None = None,
+    quant_block_size: int | None = None,
+    quant_error_feedback: bool = True,
     sentinel: bool = False,
 ) -> Callable:
     """Explicit-collectives variant of the DP train step (`shard_map`).
@@ -1034,6 +1122,8 @@ def make_train_step_shard_map(
         accum_steps=accum_steps, augment_fn=augment_fn,
         world=data_axis_size(mesh), axis_name=DATA_AXIS,
         update_sharding=update_sharding, collective_dtype=collective_dtype,
+        quant_block_size=quant_block_size,
+        quant_error_feedback=quant_error_feedback,
         sentinel=sentinel,
     )
 

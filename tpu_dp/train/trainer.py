@@ -171,6 +171,18 @@ class Trainer:
                 "reduce-scatter; set train.update_sharding=sharded"
             )
         self.update_sharding = us
+        # Quantized collectives (train.collective_dtype=int8, docs/PERF.md
+        # "Quantized collectives"): the step factories route quantizable
+        # gradient leaves through the blockwise int8 wire codec, and the
+        # TrainState carries per-replica error-feedback residuals
+        # (initialized by `_with_residuals`, resharded by load_checkpoint).
+        self._quant_enabled = cfg.train.collective_dtype in ("int8", "i8")
+        if int(cfg.train.quant_block_size) < 1:
+            raise ValueError(
+                f"train.quant_block_size must be >= 1, got "
+                f"{cfg.train.quant_block_size}"
+            )
+        self._quant_pub_step = -1  # last window whose codec stats published
 
         model_kwargs = dict(
             num_classes=num_classes, dtype=dtype,
@@ -260,10 +272,7 @@ class Trainer:
                 )
         self._build_training()
 
-        rng = jax.random.PRNGKey(cfg.train.seed)
-        sample = np.zeros((1, 32, 32, 3), np.float32)
-        self.state = create_train_state(self._init_model, rng, sample,
-                                        self.optimizer)
+        self.state = self._fresh_state()
         self.start_epoch = 0
         self.start_step = 0  # step within start_epoch (mid-epoch resume)
         self.meter = ThroughputMeter(warmup_steps=2)
@@ -470,6 +479,58 @@ class Trainer:
         if cfg.train.verify_fingerprint:
             self._verify_step_fingerprint()
 
+    def _with_residuals(self, state):
+        """Attach zero-initialized error-feedback residuals when the int8
+        wire codec is on (`train.collective_dtype=int8`); identity — and
+        an unchanged pytree — everywhere else."""
+        if not self._quant_enabled:
+            return state
+        from tpu_dp.parallel import quant
+
+        return state.replace(residuals=quant.init_residuals(
+            state.params, dist.data_axis_size(self.mesh),
+            self.cfg.train.quant_block_size,
+        ))
+
+    def _fresh_state(self) -> Any:
+        """A from-scratch TrainState for the CURRENT topology/optimizer
+        layout (+ codec residuals) — init, guard-rollback-to-nothing, and
+        regroup reload targets all build states through here so none can
+        forget a layout-bearing field."""
+        rng = jax.random.PRNGKey(self.cfg.train.seed)
+        sample = np.zeros((1, 32, 32, 3), np.float32)
+        return self._with_residuals(create_train_state(
+            self._init_model, rng, sample, self.optimizer
+        ))
+
+    def _publish_quant_counters(self, window, first_step: int) -> None:
+        """Publish the int8 codec's health counts for one window.
+
+        ``quant.overflow`` (non-finite blocks entering the codec) and
+        ``quant.clip_blocks`` (rail-crowded blocks) accumulate into the
+        counter registry, so schema-3 metrics records and `obsctl diff`
+        carry them (docs/OBSERVABILITY.md). The values are already in the
+        window's metrics — the fetch rides an EXISTING fence (the guard
+        hook's health fetch, or obs=full's per-window scalar fetch); this
+        method never adds a host sync of its own, which is why obs=basic
+        guard-off runs publish nothing. The ``first_step`` marker dedupes
+        the two call sites when both fences are live.
+        """
+        if not self._quant_enabled or first_step <= self._quant_pub_step:
+            return
+        self._quant_pub_step = first_step
+        overflow = clip = 0
+        for m in window:
+            if "quant_overflow" not in m:
+                return
+            overflow += int(np.asarray(m["quant_overflow"]))
+            clip += int(np.asarray(m["quant_clip"]))
+        # inc(0) still creates the counter: a clean run stamps an explicit
+        # quant.overflow=0 into its records — "0 overflows observed" is a
+        # statement, absence is not.
+        _obs_counters.inc("quant.overflow", overflow)
+        _obs_counters.inc("quant.clip_blocks", clip)
+
     def _guarded(self, name: str, step_fn):
         """Wrap a compiled step in a RecompileGuard (train.recompile_guard).
 
@@ -558,6 +619,7 @@ class Trainer:
                     augment_fn=augment_fn,
                     update_sharding=us,
                     collective_dtype=cfg.train.collective_dtype or None,
+                    quant_block_size=cfg.train.quant_block_size,
                     sentinel=self.guard_enabled,
                 ))
         else:
@@ -595,6 +657,7 @@ class Trainer:
                 accum_steps=cfg.optim.grad_accum_steps,
                 update_sharding=us,
                 collective_dtype=cfg.train.collective_dtype or None,
+                quant_block_size=cfg.train.quant_block_size,
                 sentinel=self.guard_enabled,
             ))
 
@@ -1109,6 +1172,7 @@ class Trainer:
                 accum_steps=self.cfg.optim.grad_accum_steps,
                 update_sharding=self.update_sharding,
                 collective_dtype=self.cfg.train.collective_dtype or None,
+                quant_block_size=self.cfg.train.quant_block_size,
                 sentinel=self.guard_enabled,
             ))
             self._resident_loops[n] = loop
@@ -1264,7 +1328,12 @@ class Trainer:
                 if obs_full:
                     # Per-step metrics.jsonl records (schema 3): spans,
                     # the window's efficiency gauges, and a counter
-                    # snapshot — one line per optimizer step.
+                    # snapshot — one line per optimizer step. The int8
+                    # codec's overflow/clip counts publish first (riding
+                    # this block's existing fence) so the same window's
+                    # records carry them.
+                    self._publish_quant_counters(window,
+                                                 self._host_step + 1)
                     snap = _obs_counters.snapshot()
                     for r in new_recs:
                         rec = {
@@ -1642,11 +1711,7 @@ class Trainer:
                 )
                 self.state = self._place_state(self.state)
             else:
-                rng = jax.random.PRNGKey(self.cfg.train.seed)
-                sample = np.zeros((1, 32, 32, 3), np.float32)
-                self.state = create_train_state(
-                    self._init_model, rng, sample, self.optimizer
-                )
+                self.state = self._fresh_state()
         else:
             from jax.experimental import multihost_utils
 
@@ -1661,11 +1726,7 @@ class Trainer:
                         Path(resume["snapshot_dir"]), self.state
                     )
                 else:
-                    state = create_train_state(
-                        self._init_model,
-                        jax.random.PRNGKey(self.cfg.train.seed),
-                        np.zeros((1, 32, 32, 3), np.float32), self.optimizer,
-                    )
+                    state = self._fresh_state()
                 pos = np.asarray([resume["epoch"], resume["steps_done"],
                                   resume["global_step"]], np.int32)
             else:
@@ -1697,6 +1758,11 @@ class Trainer:
         # heartbeats), and the cadence markers re-arm below the old
         # high-water step so the replay is snapshotted/beaten too.
         self._rollback_gen += 1
+        # Same rewind contract as the snapshot/heartbeat/audit markers: the
+        # publish marker must drop below the replay window, or the replayed
+        # steps' codec overflow/clip counts — exactly the corruption signal
+        # that may have caused this rollback — would be silently dropped.
+        self._quant_pub_step = self._host_step
         if self.ctx.process_index == 0:  # dplint: allow(DP101) host-only IO
             hook.log.tombstone(
                 from_step=from_step, to_step=self._host_step,
@@ -1808,10 +1874,7 @@ class Trainer:
         # Reload through the resharding path: the target carries the NEW
         # world's optimizer layout; `load_checkpoint` relays the saved
         # opt state onto it value-preserving (docs/PERF.md).
-        rng = jax.random.PRNGKey(cfg.train.seed)
-        sample = np.zeros((1, 32, 32, 3), np.float32)
-        target = create_train_state(self._init_model, rng, sample,
-                                    self.optimizer)
+        target = self._fresh_state()
         if resume.get("snapshot_dir"):
             self.state, _ = ckpt_lib.load_checkpoint(
                 Path(resume["snapshot_dir"]), target
@@ -1824,6 +1887,9 @@ class Trainer:
         else:
             self.state = target  # nothing on disk: restart from init
         self._host_step = int(resume.get("global_step", 0))
+        # The codec-stats publish marker rewinds with the step clock (a
+        # rollback-flavor regroup replays below the old high-water mark).
+        self._quant_pub_step = self._host_step
 
         # Re-split the interrupted epoch over the survivors: every
         # remaining sample visited exactly once (graceful), or the
@@ -1917,6 +1983,8 @@ class Trainer:
                     lambda _: sh.opt_state, state.opt_state),
                 batch_stats=jax.tree_util.tree_map(
                     lambda _: sh.batch_stats, state.batch_stats),
+                residuals=jax.tree_util.tree_map(
+                    lambda _: sh.residuals, state.residuals),
             )
         else:
             sh = jax.tree_util.tree_map(lambda _: sh, state)
