@@ -131,6 +131,96 @@ def test_queue_rejects_malformed_requests():
     assert len(q) == 0  # nothing was admitted
 
 
+def test_queue_submit_after_close_sheds_synchronously():
+    """ISSUE 11 satellite: `submit` after `close()` must shed `closed` AT
+    ADMISSION — immediate typed answer, handle resolved, counters exact —
+    never rely on a dispatch loop (possibly already dead) to notice."""
+    q = _mk_queue()
+    q.close()
+    before = counters.get("serve.shed.closed")
+    before_c0 = counters.get("serve.shed.c0")
+    with pytest.raises(ShedError) as ei:
+        q.submit(np.zeros((1, 32, 32, 3), np.uint8))
+    assert ei.value.reason == "closed"
+    assert counters.get("serve.shed.closed") == before + 1
+    assert counters.get("serve.shed.c0") == before_c0 + 1
+    assert len(q) == 0
+
+
+def test_queue_full_evicts_lowest_class_first():
+    """Burst overload sheds the bronze tier before gold: an incoming
+    higher-class request evicts the youngest queued request of the worst
+    class (typed queue_full shed) instead of being rejected itself."""
+    q = _mk_queue(max_depth=2)
+    h_gold = q.submit(np.zeros((1, 32, 32, 3), np.uint8), slo_class=0)
+    h_bronze_old = q.submit(np.zeros((1, 32, 32, 3), np.uint8), slo_class=2)
+    # Full. A same-or-worse class submit sheds itself...
+    with pytest.raises(ShedError) as ei:
+        q.submit(np.zeros((1, 32, 32, 3), np.uint8), slo_class=2)
+    assert ei.value.reason == SHED_QUEUE_FULL
+    # ...but a better-class submit evicts the queued bronze request.
+    h_silver = q.submit(np.zeros((1, 32, 32, 3), np.uint8), slo_class=1)
+    assert h_bronze_old.done() and h_bronze_old.shed_reason == SHED_QUEUE_FULL
+    assert not h_gold.done() and not h_silver.done()
+    # Dispatch order is (class, arrival): gold before silver.
+    batch, _ = q.collect(max_images=8)
+    assert [r.handle for r in batch] == [h_gold, h_silver]
+
+
+def test_doomed_request_never_evicts_viable_victim():
+    """A request already below the shed headroom sheds `deadline` BEFORE
+    the full-queue eviction decision — it must not cost a serveable
+    lower-class request its slot."""
+    q = _mk_queue(max_depth=1, shed_headroom_ms=10.0)
+    h_bronze = q.submit(np.zeros((1, 32, 32, 3), np.uint8), slo_class=2)
+    with pytest.raises(ShedError) as ei:
+        q.submit(np.zeros((1, 32, 32, 3), np.uint8), slo_class=0,
+                 slo_ms=5.0)
+    assert ei.value.reason == SHED_DEADLINE
+    assert not h_bronze.done() and len(q) == 1
+
+
+def test_queue_class_order_is_fifo_within_class():
+    q = _mk_queue(max_depth=16)
+    h_b1 = q.submit(np.zeros((1, 32, 32, 3), np.uint8), slo_class=1)
+    h_a1 = q.submit(np.zeros((1, 32, 32, 3), np.uint8), slo_class=0)
+    h_b2 = q.submit(np.zeros((1, 32, 32, 3), np.uint8), slo_class=1)
+    h_a2 = q.submit(np.zeros((1, 32, 32, 3), np.uint8), slo_class=0)
+    batch, _ = q.collect(max_images=8)
+    assert [r.handle for r in batch] == [h_a1, h_a2, h_b1, h_b2]
+
+
+def test_requeue_preserves_admission_books():
+    """Failover re-admission re-counts nothing: accepted once at submit,
+    back at the queue head with arrival/deadline intact."""
+    q = _mk_queue(max_depth=4)
+    accepted_before = counters.get("serve.accepted")
+    q.submit(np.zeros((1, 32, 32, 3), np.uint8))
+    q.submit(np.zeros((2, 32, 32, 3), np.uint8))
+    batch, _ = q.collect(max_images=8)
+    assert len(batch) == 2 and len(q) == 0
+    q.requeue(batch)
+    assert len(q) == 2 and q.pending_images() == 3
+    again, _ = q.collect(max_images=8)
+    assert [r.req_id for r in again] == [r.req_id for r in batch]
+    assert counters.get("serve.accepted") == accepted_before + 2
+
+
+def test_handle_resolves_exactly_once():
+    """The claim guard: a second resolution (the failover double-serve
+    race) is discarded — first answer wins, books untouched."""
+    from tpu_dp.serve import RequestHandle
+
+    h = RequestHandle(0, 1)
+    assert h._shed("replica_failed")
+    assert not h._resolve(np.zeros(1), np.zeros(1), 1.0, False, {})
+    assert h.shed_reason == "replica_failed" and h.predictions is None
+    h2 = RequestHandle(1, 1)
+    assert h2._resolve(np.zeros(1), np.zeros(1), 1.0, False, {})
+    assert not h2._shed("closed")
+    assert h2.ok and h2.shed_reason is None
+
+
 def test_queue_sheds_at_admission_below_headroom():
     q = _mk_queue(shed_headroom_ms=10.0)
     with pytest.raises(ShedError) as ei:
@@ -437,6 +527,118 @@ def test_engine_from_checkpoint_serves_trained_params(tmp_path, net_model):
     np.testing.assert_array_equal(h.predictions, expected)
 
 
+def test_load_params_only_drops_int8_residuals(tmp_path):
+    """ISSUE 11 satellite: a post-PR-10 checkpoint carrying the int8 wire
+    codec's `residuals` subtree (plus sharded-layout opt state) must load
+    params-only cleanly — residuals dropped, params bit-exact — and serve
+    end-to-end via from_checkpoint."""
+    from tpu_dp.checkpoint import CheckpointManager, load_params_only
+    from tpu_dp.models import build_model
+    from tpu_dp.parallel.quant import init_residuals
+    from tpu_dp.train import SGD, create_train_state, shard_optimizer
+
+    model = build_model("net")
+    opt = shard_optimizer(SGD(momentum=0.9), 8)
+    state = create_train_state(
+        model, jax.random.PRNGKey(7),
+        np.zeros((1, 32, 32, 3), np.float32), opt,
+    )
+    # The int8-trained shape: per-quantizable-leaf [world, qpad] residuals.
+    state = state.replace(residuals=init_residuals(state.params, 8))
+    assert state.residuals, "int8 net model must have quantizable leaves"
+    with CheckpointManager(tmp_path, async_save=False) as mgr:
+        mgr.save(state, {"config": {"model": {"name": "net"}}}, step=3)
+
+    variables = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
+        train=False,
+    )
+    params, batch_stats, meta = load_params_only(
+        tmp_path / "step_0000000003", variables["params"]
+    )
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(state.params),
+    ):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert batch_stats == {}
+
+    engine = InferenceEngine.from_checkpoint(
+        tmp_path, buckets=(1, 2), slo_ms=500.0
+    )
+    rng = np.random.default_rng(5)
+    images = rng.integers(0, 256, size=(2, 32, 32, 3)).astype(np.uint8)
+    with engine:
+        h = engine.submit(images)
+        assert h.wait(30.0) and h.ok
+    np.testing.assert_array_equal(
+        h.predictions, direct_predictions((model, state.params), images)
+    )
+
+
+def test_engine_hot_swap_stamps_versions_and_drops_nothing(net_model):
+    """Hot weight swap on the single-replica engine: applied between
+    batches, every response stamped with the version that served it,
+    post-swap predictions match the new weights, zero sheds."""
+    model, params = net_model
+    fresh = model.init(
+        jax.random.PRNGKey(42), np.zeros((1, 32, 32, 3), np.float32),
+        train=False,
+    )
+    rng = np.random.default_rng(6)
+    images = rng.integers(0, 256, size=(2, 32, 32, 3)).astype(np.uint8)
+    engine = make_engine(net_model)
+    with engine:
+        h1 = engine.submit(images)
+        assert h1.wait(30.0) and h1.ok and h1.model_version == 1
+        v = engine.swap_model(fresh["params"])
+        assert v == 2
+        # The pending swap applies before the next dispatched batch.
+        h2 = engine.submit(images)
+        assert h2.wait(30.0) and h2.ok
+        assert h2.model_version == 2
+    np.testing.assert_array_equal(
+        h1.predictions, direct_predictions(net_model, images)
+    )
+    np.testing.assert_array_equal(
+        h2.predictions,
+        direct_predictions((model, fresh["params"]), images),
+    )
+    assert engine.retraces == 0  # a swap is a data change, not a shape one
+    # Two swaps published between the same pair of batches get DISTINCT
+    # versions — stamps identify weights, not apply events.
+    assert engine.swap_model(params) == 3
+    assert engine.swap_model(fresh["params"]) == 4
+
+
+def test_engine_swap_from_checkpoint_accepts_manager_root(tmp_path,
+                                                          net_model):
+    """swap_from_checkpoint resolves a CheckpointManager root exactly
+    like from_checkpoint does (newest complete checkpoint)."""
+    from tpu_dp.checkpoint import CheckpointManager
+    from tpu_dp.models import build_model
+    from tpu_dp.train import SGD, create_train_state
+
+    model = build_model("net")
+    state = create_train_state(
+        model, jax.random.PRNGKey(21),
+        np.zeros((1, 32, 32, 3), np.float32), SGD(momentum=0.9),
+    )
+    with CheckpointManager(tmp_path, async_save=False) as mgr:
+        mgr.save(state, {"config": {"model": {"name": "net"}}}, step=7)
+    rng = np.random.default_rng(8)
+    images = rng.integers(0, 256, size=(2, 32, 32, 3)).astype(np.uint8)
+    engine = make_engine(net_model)
+    with engine:
+        assert engine.swap_from_checkpoint(tmp_path) == 2  # root, not step dir
+        h = engine.submit(images)
+        assert h.wait(30.0) and h.ok and h.model_version == 2
+    np.testing.assert_array_equal(
+        h.predictions, direct_predictions((model, state.params), images)
+    )
+
+
 # -- meter satellite --------------------------------------------------------
 
 def test_meter_mark_credits_variable_batch_sizes():
@@ -485,10 +687,29 @@ def test_serve_config_roundtrip_and_overrides():
     cfg.override("serve.buckets", "1,2,4")
     cfg.override("serve.slo_ms", "25.5")
     cfg.override("serve.max_queue", "64")
+    cfg.override("serve.replicas", "2")
+    cfg.override("serve.class_slo_ms", "50,100")
+    cfg.override("serve.class_floors", "0:0.9")
+    cfg.override("serve.stale_after_s", "1.5")
     d = cfg.to_dict()
     assert d["serve"]["buckets"] == "1,2,4"
     cfg2 = Config.from_dict(d)
     assert cfg2.serve.slo_ms == 25.5 and cfg2.serve.max_queue == 64
+    assert cfg2.serve.replicas == 2 and cfg2.serve.stale_after_s == 1.5
+    assert cfg2.serve.class_slo_ms == "50,100"
+
+
+def test_parse_class_slo_and_floors():
+    from tpu_dp.config import parse_class_floors, parse_class_slo_ms
+
+    assert parse_class_slo_ms("") == {}
+    assert parse_class_slo_ms("50,100,250") == {0: 50.0, 1: 100.0, 2: 250.0}
+    with pytest.raises(ValueError):
+        parse_class_slo_ms("50,x")
+    assert parse_class_floors("") == {}
+    assert parse_class_floors("0:0.9,2:0.5") == {0: 0.9, 2: 0.5}
+    with pytest.raises(ValueError):
+        parse_class_floors("0=0.9")
 
 
 def test_engine_from_serve_config(net_model):

@@ -1,0 +1,415 @@
+"""The self-healing serving tier (ISSUE 11, docs/SERVING.md).
+
+What must hold, in order of importance:
+
+1. **Exact books through chaos**: the loadgen's caller-vs-counter audit
+   (accepted / completed / shed-per-reason / deadline-missed, overall AND
+   per SLO class) and the cross-replica device-side served count stay
+   exactly consistent through replica failover, quarantine, drain,
+   rejoin, and hot swap — zero dropped, zero double-served.
+2. **Typed failure**: a dead replica's in-flight requests are retried on
+   a survivor or shed with reason ``replica_failed`` — never silently
+   dropped, never silently re-counted.
+3. **Elastic membership**: drain-then-leave and rejoin are published as
+   serving-flavored membership epochs in the PR 7 ledger format, and
+   ``obsctl timeline`` reconstructs drain → failover → swap from the run
+   directory's artifacts alone.
+4. **Hot swap**: versioned in-place weight updates between batches, every
+   response stamped with the version that served it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_dp.serve import ServeCluster, arrival_offsets, run_load
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def net_model():
+    from tpu_dp.models import build_model
+
+    model = build_model("net")
+    variables = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
+        train=False,
+    )
+    return model, variables["params"]
+
+
+def make_cluster(net_model, **kw):
+    model, params = net_model
+    kw.setdefault("replicas", 2)
+    kw.setdefault("buckets", (1, 2, 4))
+    kw.setdefault("slo_ms", 5000.0)
+    kw.setdefault("health_every_s", 0.02)
+    return ServeCluster(model, params, **kw)
+
+
+def _wait_for(predicate, timeout_s=10.0, poll_s=0.01):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll_s)
+    return False
+
+
+# -- fan-out basics ---------------------------------------------------------
+
+def test_cluster_two_replicas_exact_books_and_class_mix(net_model):
+    """120 mixed-size, mixed-class requests over 2 replicas (4 devices
+    each): exact overall AND per-class books, zero retraces, every batch
+    attributed to a replica, device-side served = caller-side served."""
+    cluster = make_cluster(net_model, class_slo_ms={0: 5000.0, 1: 8000.0})
+    with cluster:
+        report = run_load(
+            cluster, n_requests=120, pattern="poisson", rate_rps=600.0,
+            sizes=(1, 2, 3), seed=1, class_mix=(0.6, 0.4),
+        )
+    truth = report["ground_truth"]
+    assert report["consistent"], (truth, report["counters"])
+    assert truth["completed"] == truth["accepted"] == 120
+    assert truth["unresolved"] == 0
+    assert set(truth["by_class"]) == {0, 1}
+    assert report["retraces"] == 0
+    assert set(report["classes"]) <= {"0", "1"}
+    assert report["classes"]["0"]["slo_ms"] == 5000.0
+    assert report["classes"]["1"]["slo_ms"] == 8000.0
+    per_replica = report["replicas"]
+    assert len(per_replica) == 2
+    assert sum(r["batches"] for r in per_replica.values()) \
+        == report["batches"]
+    assert report["device_stats"]["served"] == truth["images_served"]
+    assert sum(report["device_stats"]["class_counts"]) \
+        == truth["images_served"]
+    assert report["world"] == 8  # 2 replicas x 4 devices
+
+
+def test_cluster_from_serve_config(net_model):
+    from tpu_dp.config import ServeConfig
+
+    model, params = net_model
+    cluster = ServeCluster.from_serve_config(
+        model, params,
+        ServeConfig(replicas=2, buckets="1,2", slo_ms=99.0,
+                    class_slo_ms="99,200", stale_after_s=1.25),
+    )
+    assert cluster.n_replicas == 2
+    assert cluster.ladder.buckets == (1, 2)
+    assert cluster.class_slo_ms == {0: 99.0, 1: 200.0}
+    assert cluster.stale_after_s == 1.25
+
+
+# -- failover (ISSUE 11 satellite: delay-poisoned + killed in one run) ------
+
+def test_failover_bookkeeping_slow_plus_dead_replica(net_model):
+    """One replica delay-poisoned (TPU_DP_FAULT grammar, rank=sid), the
+    other killed mid-run by a raising program: the dead replica's
+    in-flight requests are retried on the survivor, accepted ==
+    completed + shed(per-reason), and the device-side served count equals
+    the caller count — zero double-served requests."""
+    cluster = make_cluster(
+        net_model,
+        fault="delay:step=2,ms=300,rank=0",
+        stale_after_s=30.0,  # quarantine not under test here
+        max_retries=1,
+    )
+    cluster.start()
+
+    def boom(*a, **k):
+        raise RuntimeError("injected replica death")
+
+    for bucket in cluster.replicas[1]._programs:
+        cluster.replicas[1]._programs[bucket] = boom
+    report = run_load(
+        cluster, n_requests=60, pattern="poisson", rate_rps=400.0,
+        sizes=(1, 2), seed=3,
+    )
+    cluster.stop()  # must NOT raise: the survivor absorbed the failure
+    truth = report["ground_truth"]
+    assert report["replicas"]["1"]["status"] == "dead"
+    assert report["replica_errors"] and \
+        "injected replica death" in report["replica_errors"][0]["error"]
+    # The dead replica had an in-flight batch; its requests were retried.
+    assert report["counters"].get("serve.failover.retried", 0) >= 1
+    assert report["consistent"], (truth, report["counters"])
+    assert truth["unresolved"] == 0
+    shed = truth["shed_by_reason"]
+    assert set(shed) <= {"replica_failed"}, shed
+    assert truth["completed"] + truth["shed"] == 60
+    # Zero double-serves: device-side served across BOTH replicas equals
+    # the images the callers actually saw answered.
+    assert report["device_stats"]["served"] == truth["images_served"]
+    # The failure is on the membership record (when a run_dir exists it
+    # is also on disk; here the in-memory epoch view suffices via report).
+    assert report["replicas"]["0"]["status"] in ("running", "stopped")
+
+
+def test_all_replicas_dead_sheds_typed_and_stop_raises(net_model):
+    """When the WHOLE tier dies, queued requests shed `replica_failed`
+    (typed, counted) and stop() surfaces the failure."""
+    cluster = make_cluster(net_model, max_retries=0)
+    cluster.start()
+
+    def boom(*a, **k):
+        raise RuntimeError("tier wipeout")
+
+    for r in cluster.replicas:
+        for bucket in r._programs:
+            r._programs[bucket] = boom
+    handles = [
+        cluster.submit(np.zeros((1, 32, 32, 3), np.uint8))
+        for _ in range(6)
+    ]
+    assert _wait_for(
+        lambda: all(r.status == "dead" for r in cluster.replicas)
+    )
+    for h in handles:
+        assert h.wait(10.0)
+        assert not h.ok and h.shed_reason in ("replica_failed",)
+    with pytest.raises(RuntimeError, match="all 2 serve replicas failed"):
+        cluster.stop()
+
+
+# -- quarantine (stale heartbeat while holding work) ------------------------
+
+def test_wedged_replica_quarantined_then_restored(net_model, tmp_path):
+    """A replica wedged in a long device call (injected delay) goes
+    heartbeat-stale while holding an in-flight batch: the router
+    quarantines it (stops feeding), the survivor keeps serving, and the
+    books stay exact; when the wedge clears it is restored. Health is
+    derived from the same heartbeat files the trainer's HealthMonitor
+    reads."""
+    cluster = make_cluster(
+        net_model,
+        run_dir=str(tmp_path),
+        stale_after_s=0.25,
+        fault="delay:step=0,ms=1200,rank=0",
+    )
+    with cluster:
+        handles = []
+        # Keep offering singles until replica 0 takes one and wedges.
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline and \
+                not cluster.replicas[0].quarantined:
+            handles.append(
+                cluster.submit(np.zeros((1, 32, 32, 3), np.uint8))
+            )
+            time.sleep(0.02)
+        assert cluster.replicas[0].quarantined, \
+            "router never quarantined the wedged replica"
+        snap = cluster._counters.snapshot()
+        assert snap.get("serve.replica_quarantine_events", 0) >= 1
+        assert snap.get("serve.replica_health.0") == 0.0
+        # The wedge clears (the delay is one-shot) → restored.
+        assert _wait_for(lambda: not cluster.replicas[0].quarantined)
+        for h in handles:
+            assert h.wait(30.0) and h.ok
+    snap = cluster._counters.snapshot()
+    assert snap.get("serve.replica_health.0") == 1.0
+    assert cluster.replicas[0].status in ("running", "stopped")
+    # The heartbeat files the quarantine derived from are on disk.
+    assert (tmp_path / "obs" / "heartbeat_r00000.jsonl").exists()
+    assert (tmp_path / "obs" / "heartbeat_r00001.jsonl").exists()
+
+
+# -- elastic drain / rejoin + the forensic timeline -------------------------
+
+def test_drain_rejoin_swap_chaos_matrix(net_model, tmp_path):
+    """The ISSUE 11 acceptance scenario, in-process: burst traffic with a
+    mid-run drain of replica 1, a hot swap, and a rejoin — exact books,
+    membership epochs on disk, version-stamped responses, and an obsctl
+    timeline that reconstructs drain → swap → rejoin from the artifacts
+    directory alone."""
+    from tpu_dp.obs import flightrec
+
+    model, params = net_model
+    fresh = model.init(
+        jax.random.PRNGKey(11), np.zeros((1, 32, 32, 3), np.float32),
+        train=False,
+    )
+    cluster = make_cluster(net_model, run_dir=str(tmp_path))
+    flightrec.recorder.reset()
+    flightrec.recorder.configure(
+        rank=0, dump_dir=tmp_path / "obs", fresh=True,
+        run={"kind": "serve-test"},
+    )
+    try:
+        def drain():
+            cluster.drain(1)
+
+        def rejoin():
+            assert _wait_for(
+                lambda: cluster.replicas[1].status == "left"
+            ), "drain never completed"
+            cluster.rejoin(1)
+
+        def swap():
+            cluster.swap_model(fresh["params"])
+
+        with cluster:
+            report = run_load(
+                cluster, n_requests=150, pattern="burst", burst=10,
+                rate_rps=500.0, sizes=(1, 2), seed=4,
+                class_mix=(0.7, 0.3),
+                events=[(25, "drain", drain), (60, "swap", swap),
+                        (100, "rejoin", rejoin)],
+            )
+        flightrec.recorder.dump(reason="test_exit")
+    finally:
+        flightrec.recorder.reset()
+
+    truth = report["ground_truth"]
+    assert report["consistent"], (truth, report["counters"])
+    assert truth["unresolved"] == 0
+    assert report["retraces"] == 0  # rejoin reused the compiled programs
+    # Both versions actually served, and the stamps account for everything.
+    assert set(truth["served_by_version"]) == {"1", "2"}
+    assert sum(truth["served_by_version"].values()) == truth["completed"]
+    assert report["model_version"] == 2
+    # Membership: initial → departure → rejoin, in the PR 7 ledger format.
+    led = sorted(
+        p.name for p in (tmp_path / "membership" / "serve").glob("epoch_*")
+    )
+    assert led == ["epoch_0000.json", "epoch_0001.json", "epoch_0002.json"]
+    e1 = json.loads(
+        (tmp_path / "membership" / "serve" / "epoch_0001.json").read_text()
+    )
+    assert e1["members"] == [0]
+    assert e1["departed"][0]["sid"] == 1
+    e2 = json.loads(
+        (tmp_path / "membership" / "serve" / "epoch_0002.json").read_text()
+    )
+    assert e2["members"] == [0, 1] and e2["reason"] == "serve_rejoin"
+
+    # obsctl reconstructs the story from the run dir alone.
+    from tpu_dp.obs.obsctl import RunArtifacts, build_timeline
+
+    timeline = build_timeline(RunArtifacts(tmp_path))
+    kinds = [e["kind"] for e in timeline["events"]]
+    for expected in ("membership_formed", "serve_dispatch", "replica_drain",
+                     "eviction", "model_swap", "replica_rejoin",
+                     "membership_epoch"):
+        assert expected in kinds, (expected, sorted(set(kinds)))
+    # The drain precedes the rejoin in the merged, ordered stream.
+    assert kinds.index("replica_drain") < kinds.index("replica_rejoin")
+
+
+def test_sigterm_drains_one_replica(net_model):
+    """Real SIGTERM to the serving process means drain-then-leave for the
+    configured replica: the handler only records, the health loop drains,
+    the survivor keeps serving, and the books stay exact."""
+    cluster = make_cluster(net_model)
+    cluster.install_sigterm_drain(sid=1)
+    try:
+        with cluster:
+            h = cluster.submit(np.zeros((1, 32, 32, 3), np.uint8))
+            assert h.wait(30.0) and h.ok
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert _wait_for(
+                lambda: cluster.replicas[1].status == "left"
+            ), "SIGTERM never drained replica 1"
+            # The survivor still serves.
+            h2 = cluster.submit(np.zeros((1, 32, 32, 3), np.uint8))
+            assert h2.wait(30.0) and h2.ok and h2.served_by == 0
+    finally:
+        cluster.restore_sigterm()
+    snap = cluster._counters.snapshot()
+    assert snap.get("preempt.signals", 0) >= 1
+
+
+# -- loadgen: diurnal pattern ----------------------------------------------
+
+def test_arrival_offsets_diurnal_ramps():
+    rng = np.random.default_rng(0)
+    n = 2000
+    off = arrival_offsets(n, "diurnal", 1000.0, 8, rng)
+    assert len(off) == n and (np.diff(off) >= 0).all() and off[0] == 0
+    # Mid-run (peak) arrivals are denser than the edges (trough): compare
+    # the time the first/last deciles take against the middle decile.
+    d = n // 10
+    edge = (off[d] - off[0]) + (off[-1] - off[-d])
+    mid = off[n // 2 + d // 2] - off[n // 2 - d // 2]
+    assert mid < edge / 3  # peak rate ~4x trough; generous margin
+    with pytest.raises(ValueError):
+        arrival_offsets(5, "diurnal", 0.0, 8, rng)
+
+
+# -- obsctl: serve attainment/p95 gate (ISSUE 11 satellite) -----------------
+
+def _serve_report_fixture(attainment0=0.95, p95=40.0):
+    return {
+        "slo": {"target_ms": 50.0, "attainment": 0.9},
+        "latency_ms": {"p95_ms": p95, "n": 100},
+        "classes": {
+            "0": {"slo_ms": 50.0, "attainment": attainment0, "n": 60},
+            "1": {"slo_ms": 250.0, "attainment": 0.8, "n": 40},
+        },
+        "counters": {"serve.accepted": 100},
+        "ground_truth": {"accepted": 100},
+    }
+
+
+def test_obsctl_diff_gates_serve_attainment_and_p95(tmp_path, capsys):
+    """`obsctl diff` gates per-class serve attainment and p95 exactly
+    like MFU: exit 0 clean, 1 on regression, 2 when nothing comparable."""
+    from tpu_dp.obs.obsctl import main as obsctl_main
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    (run_dir / "serve_elastic_report.json").write_text(
+        json.dumps(_serve_report_fixture())
+    )
+    base = tmp_path / "base.json"
+    assert obsctl_main(
+        ["diff", str(run_dir), "--write-baseline", str(base)]
+    ) == 0
+    minted = json.loads(base.read_text())
+    assert minted["serve_attainment_c0"] == 0.95
+    assert minted["serve_p95_ms"] == 40.0
+    # Clean: run vs its own baseline.
+    assert obsctl_main(
+        ["diff", str(run_dir), "--baseline", str(base)]
+    ) == 0
+    # Regression: class-0 attainment collapses below the bound.
+    bad_dir = tmp_path / "bad"
+    bad_dir.mkdir()
+    (bad_dir / "serve_elastic_report.json").write_text(
+        json.dumps(_serve_report_fixture(attainment0=0.5))
+    )
+    assert obsctl_main(
+        ["diff", str(bad_dir), "--baseline", str(base)]
+    ) == 1
+    # Regression: p95 blows past the tolerance band.
+    slow_dir = tmp_path / "slow"
+    slow_dir.mkdir()
+    (slow_dir / "serve_elastic_report.json").write_text(
+        json.dumps(_serve_report_fixture(p95=400.0))
+    )
+    assert obsctl_main(
+        ["diff", str(slow_dir), "--baseline", str(base)]
+    ) == 1
+    # A raw serve report works as the baseline too (known-good run gates
+    # the next one directly).
+    assert obsctl_main(
+        ["diff", str(run_dir), "--baseline",
+         str(run_dir / "serve_elastic_report.json")]
+    ) == 0
+    # Nothing comparable: no serve report, no metrics → exit 2.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert obsctl_main(
+        ["diff", str(empty), "--baseline", str(base)]
+    ) == 2
+    capsys.readouterr()

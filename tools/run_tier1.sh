@@ -81,6 +81,24 @@
 #                                    # archives artifacts/serve_report.json
 #                                    # with SLO attainment + shed counts)
 #                                    # + the -m serve tests.
+#   tools/run_tier1.sh --serve-elastic # self-healing serving lane: the
+#                                    # chaos scenario matrix — 2 replicas
+#                                    # over the 8-device CPU mesh, bursty
+#                                    # two-class traffic, replica 0 delay-
+#                                    # poisoned, replica 1 killed mid-load
+#                                    # (leave: fault, the SIGTERM twin)
+#                                    # then rejoined, one hot weight swap.
+#                                    # Exit-coded audit: exact books incl.
+#                                    # per-class, typed shed reasons only,
+#                                    # class-0 attainment >= floor, both
+#                                    # model versions served; obsctl must
+#                                    # rebuild drain → swap → rejoin from
+#                                    # the run dir alone and the serve
+#                                    # diff gate must pass clean AND trip
+#                                    # on a tampered baseline. Archives
+#                                    # artifacts/serve_elastic_report.json
+#                                    # + serve_elastic_timeline.json, then
+#                                    # the -m serve tests.
 #
 # Exit code is pytest's; the DOTS_PASSED line echoes the pass count the
 # roadmap tracks across PRs.
@@ -325,6 +343,75 @@ print("quant smoke:", json.dumps({"compression_vs_f32":
 PY
     echo "quant smoke: artifacts/quant_report.json"
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m quant \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--serve-elastic" ]; then
+    # The smoke is its own verdict (python -m tpu_dp.serve exits 1 on any
+    # book mismatch, retrace, or attainment-floor miss); the python block
+    # then pins the chaos artifacts: typed shed reasons, both weight
+    # versions served, the membership ledger's drain+rejoin epochs, the
+    # obsctl timeline kinds, and the serve diff gate in both directions.
+    mkdir -p artifacts
+    SMOKE=$(mktemp -d /tmp/tpu_dp_serve_el.XXXXXX) || exit 1
+    env JAX_PLATFORMS=cpu python -m tpu_dp.serve \
+        --replicas 2 --requests 280 --pattern burst --burst 12 \
+        --rate-rps 400 --buckets 1,2,4,8 --max-wait-ms 2 \
+        --slo-ms 3000 --class-mix 0.6,0.4 --class-slo-ms 3000,6000 \
+        --floors 0:0.9 --stale-after-s 0.3 \
+        --fault "delay:step=3,ms=500,rank=0;leave:step=4,rank=1" \
+        --rejoin-at 200:1 --swap-at 120 \
+        --run-dir "$SMOKE/run" \
+        --out artifacts/serve_elastic_report.json > /dev/null || exit $?
+    cp artifacts/serve_elastic_report.json "$SMOKE/run/" || exit 1
+    env JAX_PLATFORMS=cpu python -m tpu_dp.obs timeline "$SMOKE/run" \
+        --json > artifacts/serve_elastic_timeline.json || exit $?
+    env JAX_PLATFORMS=cpu python -m tpu_dp.obs diff "$SMOKE/run" \
+        --write-baseline "$SMOKE/base.json" || exit $?
+    env JAX_PLATFORMS=cpu python -m tpu_dp.obs diff "$SMOKE/run" \
+        --baseline "$SMOKE/base.json" > /dev/null || exit $?
+    env JAX_PLATFORMS=cpu python - "$SMOKE" <<'PY' || exit 1
+import json, subprocess, sys
+from pathlib import Path
+smoke = Path(sys.argv[1])
+rep = json.loads(Path("artifacts/serve_elastic_report.json").read_text())
+assert rep["verdict"]["ok"] and rep["consistent"], rep["verdict"]
+t = rep["ground_truth"]
+known = {"queue_full", "deadline", "closed", "replica_failed"}
+assert set(t["shed_by_reason"]) <= known, t["shed_by_reason"]
+assert t["completed"] + t["shed"] + t["unresolved"] == t["submitted"]
+assert set(t["served_by_version"]) == {"1", "2"}, t["served_by_version"]
+assert rep["classes"]["0"]["attainment"] >= 0.9, rep["classes"]
+assert rep["membership_epoch"] == 2, rep["membership_epoch"]  # leave+rejoin
+led = sorted(p.name for p in (smoke/"run/membership/serve").glob("epoch_*"))
+assert len(led) == 3, led
+tl = json.loads(Path("artifacts/serve_elastic_timeline.json").read_text())
+kinds = [e["kind"] for e in tl["events"]]
+for k in ("membership_formed", "serve_dispatch", "replica_drain",
+          "eviction", "model_swap", "replica_rejoin", "membership_epoch"):
+    assert k in kinds, (k, sorted(set(kinds)))
+# The gate must also TRIP: a tampered baseline demanding impossible
+# class-0 attainment has to exit 1, or the diff is a rubber stamp.
+base = json.loads((smoke/"base.json").read_text())
+assert base["serve_attainment_c0"] is not None, base
+tampered = dict(base, serve_attainment_c0=1.5)
+(smoke/"tampered.json").write_text(json.dumps(tampered))
+rc = subprocess.run(
+    [sys.executable, "-m", "tpu_dp.obs", "diff", str(smoke/"run"),
+     "--baseline", str(smoke/"tampered.json")],
+    capture_output=True, text=True,
+).returncode
+assert rc == 1, f"tampered baseline must exit 1, got {rc}"
+print("serve-elastic smoke:", json.dumps({
+    "completed": t["completed"], "shed": t["shed_by_reason"],
+    "versions": t["served_by_version"],
+    "attainment_c0": rep["classes"]["0"]["attainment"],
+    "timeline_events": len(kinds), "diff_tampered_exit": rc,
+}))
+PY
+    rm -rf "$SMOKE"
+    echo "serve-elastic smoke: artifacts/serve_elastic_report.json + serve_elastic_timeline.json"
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serve \
         -p no:cacheprovider
 fi
 

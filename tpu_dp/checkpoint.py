@@ -484,12 +484,18 @@ def load_params_only(
     ``train.update_sharding=sharded`` their layout additionally depends on
     the world size the checkpoint was written under. This loader restores
     only the ``params`` (and, when a target is given, ``batch_stats``)
-    subtrees against their targets; the opt_state subtree is dropped
-    without shape validation, device transfer, or the resharding dance
-    `load_checkpoint` performs — which is exactly why a checkpoint written
-    under ANY world size or update-sharding mode loads here unchanged:
-    params and batch stats are always stored in the canonical global
-    (replicated) layout (`leaf_to_host`), so there is nothing to reshard.
+    subtrees against their targets; every training-only subtree —
+    ``opt_state`` AND the int8 wire codec's error-feedback ``residuals``
+    (post-PR-10 checkpoints carry them; serving never needs pending
+    gradient corrections) — is dropped without shape validation, device
+    transfer, or the resharding dance `load_checkpoint` performs. That
+    subtree selection (never a whole-tree `from_state_dict`, which would
+    demand a shape-compatible target for every training-only leaf) is
+    exactly why a checkpoint written under ANY world size, update-sharding
+    mode, or collective dtype loads here unchanged: params and batch stats
+    are always stored in the canonical global (replicated) layout
+    (`leaf_to_host`), so there is nothing to reshard — pinned by
+    `tests/test_serve.py::test_load_params_only_drops_int8_residuals`.
 
     Returns ``(params, batch_stats, meta)``; ``batch_stats`` is ``{}``
     when no target is given or the checkpoint carries none.
@@ -503,6 +509,9 @@ def load_params_only(
             f"(no 'params' subtree) — for a bare `save_params` export use "
             f"`load_params`"
         )
+    # Training-only subtrees are dropped HERE, by never touching them:
+    # only the keys below are read out of `raw`. A new TrainState field
+    # (like PR 10's `residuals`) therefore can never break serving.
     params = serialization.from_state_dict(
         _to_host(target_params), raw["params"], name="params"
     )

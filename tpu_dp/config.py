@@ -285,7 +285,8 @@ class ServeConfig:
     # the largest bucket OR the oldest request has waited this long.
     max_wait_ms: float = 5.0
     # Queue bound (requests): past this depth `submit` sheds with reason
-    # "queue_full" instead of converting overload into deadline misses.
+    # "queue_full" instead of converting overload into deadline misses —
+    # lowest SLO class first (serve/queue.py).
     max_queue: int = 256
     # Per-request latency target; attainment (fraction of completed
     # requests within it) is reported from the obs spans.
@@ -296,7 +297,63 @@ class ServeConfig:
     shed_headroom_ms: float = 0.0
     # Heartbeat/span directory ("" = disabled): per-batch heartbeats land
     # here so serve stragglers are attributable with obs.HealthMonitor.
+    # Single-engine only — the multi-replica tier uses run_dir below.
     obs_dir: str = ""
+    # Replica fan-out (tpu_dp/serve/router.py): N ServeReplica workers
+    # over disjoint device subsets behind one shared admission queue,
+    # with heartbeat-derived health, failover, drain/rejoin and hot swap.
+    replicas: int = 1
+    # Serving artifact root ("" = disabled): per-replica heartbeats land
+    # under <run_dir>/obs, the serving membership ledger under
+    # <run_dir>/membership/serve — the tree `obsctl timeline` rebuilds
+    # the drain → failover → swap story from.
+    run_dir: str = ""
+    # A replica whose heartbeat is older than this WHILE it holds an
+    # in-flight batch is quarantined (the router stops feeding it) until
+    # it beats again; a dead one fails over.
+    stale_after_s: float = 2.0
+    # Failover budget: how many times a dead replica's in-flight request
+    # is retried on a survivor before shedding "replica_failed".
+    max_retries: int = 1
+    # Per-SLO-class latency targets, highest class (0) first, e.g.
+    # "50,100,250" — classes beyond the list fall back to slo_ms.
+    # Per-class attainment lands in the serve report and obsctl diff.
+    class_slo_ms: str = ""
+    # Per-class attainment floors, "0:0.9,1:0.5" — the serve CLI exits 1
+    # when a listed class completes below its floor (chaos acceptance).
+    class_floors: str = ""
+
+
+def parse_class_slo_ms(spec: str) -> dict[int, float]:
+    """Parse `ServeConfig.class_slo_ms`: per-class targets, class 0 first."""
+    spec = (spec or "").strip()
+    if not spec:
+        return {}
+    try:
+        return {i: float(s) for i, s in enumerate(spec.split(","))}
+    except ValueError:
+        raise ValueError(
+            f"class_slo_ms must be comma-separated milliseconds, got {spec!r}"
+        ) from None
+
+
+def parse_class_floors(spec: str) -> dict[int, float]:
+    """Parse `ServeConfig.class_floors`: ``class:attainment`` pairs."""
+    spec = (spec or "").strip()
+    if not spec:
+        return {}
+    out = {}
+    for item in spec.split(","):
+        cls, sep, floor = item.partition(":")
+        try:
+            if not sep:
+                raise ValueError
+            out[int(cls)] = float(floor)
+        except ValueError:
+            raise ValueError(
+                f"class_floors must be class:attainment pairs, got {spec!r}"
+            ) from None
+    return out
 
 
 @dataclass

@@ -61,6 +61,10 @@ MARKER_KINDS = (
     "guard_trigger", "guard_rollback", "guard_halt", "eviction",
     "membership_epoch", "elastic_regroup", "elastic_departure",
     "preempt_signal", "preempt_exit", "dump_request", "exit",
+    # the serving tier's lifecycle (tpu_dp/serve/router.py): drain →
+    # failover → swap must be reconstructable from artifacts alone.
+    "model_swap", "replica_failed", "replica_drain", "replica_rejoin",
+    "replica_quarantined", "replica_restored",
 )
 
 #: Event kinds describing one REPLICATED decision that reaches the
@@ -120,11 +124,16 @@ def _read_jsonl(path: Path) -> list[dict]:
     return out
 
 
+#: filenames probed (in order) for a run's archived serve report.
+_SERVE_REPORT_NAMES = ("serve_elastic_report.json", "serve_report.json")
+
+
 class RunArtifacts:
     """Everything obsctl can find under one run directory."""
 
     def __init__(self, run_dir: str | Path,
-                 metrics_path: str | Path | None = None):
+                 metrics_path: str | Path | None = None,
+                 serve_report_path: str | Path | None = None):
         self.run_dir = Path(run_dir)
         if not self.run_dir.exists():
             raise FileNotFoundError(f"run dir {self.run_dir} does not exist")
@@ -135,6 +144,25 @@ class RunArtifacts:
         self.obs_dir = self.run_dir / "obs"
         self.quarantine_path = self.run_dir / "quarantine.jsonl"
         self.membership_dir = self.run_dir / "membership"
+        self.serve_report_path = None
+        if serve_report_path:
+            self.serve_report_path = Path(serve_report_path)
+        else:
+            for name in _SERVE_REPORT_NAMES:
+                if (self.run_dir / name).exists():
+                    self.serve_report_path = self.run_dir / name
+                    break
+
+    def serve_report(self) -> dict | None:
+        """The run's audited serve report, when one was archived."""
+        if self.serve_report_path is None or \
+                not self.serve_report_path.exists():
+            return None
+        try:
+            rec = json.loads(self.serve_report_path.read_text())
+        except (OSError, ValueError):
+            return None
+        return rec if isinstance(rec, dict) else None
 
     def metrics(self) -> list[dict]:
         return _read_jsonl(self.metrics_path)
@@ -424,8 +452,38 @@ def _quant_counters(metrics: list[dict]) -> dict:
     }
 
 
+def serve_signals(report: dict) -> dict:
+    """Gateable serve signals out of an audited serve report.
+
+    ``serve_attainment`` (overall) and per-class ``serve_attainment_c<k>``
+    are lower-is-worse; ``serve_p95_ms`` is higher-is-worse — the serving
+    twins of mfu/goodput/p95, so a shed-storm or latency regression in
+    the replica tier fails CI exactly like an MFU drop. Missing blocks
+    produce no key: absence is surfaced as ``skipped``, never a fake 0.
+    """
+    out: dict[str, float] = {}
+    slo = report.get("slo") or {}
+    if slo.get("attainment") is not None:
+        out["serve_attainment"] = float(slo["attainment"])
+    lat = report.get("latency_ms") or {}
+    if lat.get("p95_ms") is not None:
+        out["serve_p95_ms"] = float(lat["p95_ms"])
+    for cls, blk in sorted((report.get("classes") or {}).items()):
+        if isinstance(blk, dict) and blk.get("attainment") is not None:
+            out[f"serve_attainment_c{cls}"] = float(blk["attainment"])
+    return out
+
+
+def _is_serve_report(rec: dict) -> bool:
+    """A raw serve report (vs a BENCH record / obsctl baseline)."""
+    return "ground_truth" in rec or (
+        isinstance(rec.get("slo"), dict) and "counters" in rec
+    )
+
+
 def run_efficiency(art: RunArtifacts) -> dict:
-    """The run's {mfu, goodput, p95_ms, quant_*} from its metrics stream.
+    """The run's {mfu, goodput, p95_ms, quant_*, serve_*} from its metrics
+    stream and (when archived) its serve report.
 
     Prefers the epoch records' ``efficiency`` rollups (schema 3, written
     by the live accounting); falls back to recomputing from per-step
@@ -437,6 +495,7 @@ def run_efficiency(art: RunArtifacts) -> dict:
     """
     metrics = sweep_rollback_generations(art.metrics())
     quant = _quant_counters(metrics)
+    serve = serve_signals(art.serve_report() or {})
     eff_recs = [r["efficiency"] for r in metrics
                 if "epoch" in r and isinstance(r.get("efficiency"), dict)]
     if eff_recs:
@@ -447,12 +506,14 @@ def run_efficiency(art: RunArtifacts) -> dict:
             "p95_ms": (last.get("step_time_ms") or {}).get("p95"),
             "source": "epoch_efficiency_rollup",
             **quant,
+            **serve,
         }
     per_step = [r for r in metrics
                 if "spans" in r and "event" not in r and "epoch" not in r]
     if not per_step:
         return {"mfu": None, "goodput": None, "p95_ms": None,
-                "source": "none", **quant}
+                "source": "serve_report" if serve else "none",
+                **quant, **serve}
     totals, waits, mfus, goodputs = [], [], [], []
     for r in per_step:
         spans = r["spans"]
@@ -472,16 +533,22 @@ def run_efficiency(art: RunArtifacts) -> dict:
         "p95_ms": round(percentile(sorted(totals), 95), 3),
         "source": "per_step_spans",
         **quant,
+        **serve,
     }
 
 
 def load_baseline(path: Path) -> dict:
-    """{mfu, goodput, p95_ms, quant_*_per_step} out of a BENCH_*.json (or
-    obsctl baseline). Quant rates come from the baseline's own per-step
-    keys, or from a BENCH record's ``quant`` block — whose overflow /
-    clip_blocks totals cover ``stats_steps`` fenced steps and are
-    normalized here so run and baseline always compare in the same unit
-    (blocks per optimizer step)."""
+    """{mfu, goodput, p95_ms, quant_*_per_step, serve_*} out of a
+    BENCH_*.json, an obsctl baseline, or a raw serve report. Quant rates
+    come from the baseline's own per-step keys, or from a BENCH record's
+    ``quant`` block — whose overflow / clip_blocks totals cover
+    ``stats_steps`` fenced steps and are normalized here so run and
+    baseline always compare in the same unit (blocks per optimizer
+    step). Serve signals come from direct ``serve_*`` keys (obsctl
+    baseline), a BENCH record's ``serve`` block, or — when the baseline
+    file *is* an archived serve report — its slo/latency/classes blocks,
+    so `serve_elastic_report.json` of a known-good run gates the next
+    one directly."""
     rec = json.loads(path.read_text())
     latency = rec.get("latency") or {}
     quant = rec.get("quant") or {}
@@ -490,6 +557,12 @@ def load_baseline(path: Path) -> dict:
     def rate(total):
         return None if total is None else round(total / q_steps, 4)
 
+    if _is_serve_report(rec):
+        serve = serve_signals(rec)
+    else:
+        serve = serve_signals(rec.get("serve") or {})
+        serve.update({k: v for k, v in rec.items()
+                      if k.startswith("serve_") and v is not None})
     return {
         "mfu": rec.get("mfu"),
         "goodput": rec.get("goodput"),
@@ -498,26 +571,38 @@ def load_baseline(path: Path) -> dict:
             "quant_overflow_per_step", rate(quant.get("overflow"))),
         "quant_clip_blocks_per_step": rec.get(
             "quant_clip_blocks_per_step", rate(quant.get("clip_blocks"))),
+        **serve,
     }
 
 
 def diff_verdict(run: dict, base: dict, tolerance: float) -> dict:
     """Per-signal verdicts + the overall regression flag.
 
-    Lower-is-worse signals (mfu, goodput) regress below
-    ``base x (1 - tolerance)``; higher-is-worse (p95_ms, and the int8
-    codec's per-step quant_overflow / quant_clip_blocks rates) above
-    ``base x (1 + tolerance)`` — with a zero-rate baseline that bound is
-    zero, so ANY overflow where the baseline had none is a regression
-    (exactly right: overflow means non-finite blocks entered the codec).
-    Signals missing on either side are reported ``skipped`` — absence of
-    evidence is surfaced, never silently passed.
+    Lower-is-worse signals (mfu, goodput, and the serving tier's overall
+    + per-class ``serve_attainment*``) regress below
+    ``base x (1 - tolerance)``; higher-is-worse (p95_ms, the serving
+    ``serve_p95_ms``, and the int8 codec's per-step quant_overflow /
+    quant_clip_blocks rates) above ``base x (1 + tolerance)`` — with a
+    zero-rate baseline that bound is zero, so ANY overflow where the
+    baseline had none is a regression (exactly right: overflow means
+    non-finite blocks entered the codec). Signals missing on either side
+    are reported ``skipped`` — absence of evidence is surfaced, never
+    silently passed.
     """
+    signals = [("mfu", True), ("goodput", True),
+               ("p95_ms", False),
+               ("quant_overflow_per_step", False),
+               ("quant_clip_blocks_per_step", False)]
+    # Serving signals are open-ended (one attainment per SLO class), so
+    # the comparison set is whatever either side carries — per-class
+    # attainment gates like MFU, serve p95 like step-time p95.
+    for key in sorted(set(run) | set(base)):
+        if key.startswith("serve_attainment"):
+            signals.append((key, True))
+        elif key.startswith("serve_p95_ms"):
+            signals.append((key, False))
     checks = []
-    for key, worse_is_lower in (("mfu", True), ("goodput", True),
-                                ("p95_ms", False),
-                                ("quant_overflow_per_step", False),
-                                ("quant_clip_blocks_per_step", False)):
+    for key, worse_is_lower in signals:
         r, b = run.get(key), base.get(key)
         if r is None or b is None:
             checks.append({"signal": key, "verdict": "skipped",
@@ -678,7 +763,8 @@ def cmd_merge_trace(args) -> int:
 
 
 def cmd_diff(args) -> int:
-    art = RunArtifacts(args.run_dir, metrics_path=args.metrics)
+    art = RunArtifacts(args.run_dir, metrics_path=args.metrics,
+                       serve_report_path=getattr(args, "serve_report", None))
     run = run_efficiency(art)
     if args.write_baseline:
         payload = {
@@ -689,6 +775,8 @@ def cmd_diff(args) -> int:
             "quant_overflow_per_step": run.get("quant_overflow_per_step"),
             "quant_clip_blocks_per_step": run.get(
                 "quant_clip_blocks_per_step"),
+            **{k: v for k, v in sorted(run.items())
+               if k.startswith("serve_")},
             "source_run": str(art.run_dir),
             "source": run["source"],
         }
@@ -708,12 +796,13 @@ def cmd_diff(args) -> int:
         print(json.dumps(verdict))
     else:
         for c in verdict["checks"]:
-            print(f"{c['signal']:<8} run={c['run']} "
+            print(f"{c['signal']:<26} run={c['run']} "
                   f"baseline={c['baseline']} -> {c['verdict']}")
     if verdict["compared"] == 0:
         print("obsctl diff: no signal present on both sides — cannot "
-              "certify; run with train.obs=basic|full and a baseline "
-              "carrying mfu/goodput/latency.p95_ms", file=sys.stderr)
+              "certify; run with train.obs=basic|full (or archive a serve "
+              "report) and a baseline carrying mfu/goodput/latency.p95_ms "
+              "or serve_attainment/serve_p95_ms", file=sys.stderr)
         return 2
     if verdict["regressed"]:
         print("obsctl diff: REGRESSION", file=sys.stderr)
@@ -757,6 +846,11 @@ def main(argv=None) -> int:
     p = sub.add_parser("diff",
                        help="regression verdict vs a BENCH_*.json baseline")
     common(p)
+    p.add_argument("--serve-report", default=None,
+                   help="audited serve report JSON (default: "
+                        "<run>/serve_elastic_report.json or "
+                        "<run>/serve_report.json) — gates per-class "
+                        "attainment + p95 like mfu")
     p.add_argument("--baseline", default=None)
     p.add_argument("--tolerance", type=float, default=0.1,
                    help="relative slack before a delta is a regression")

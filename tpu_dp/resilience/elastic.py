@@ -435,6 +435,70 @@ class MembershipLedger:
         return sorted(pending)
 
 
+class ServeMembership:
+    """Serving-flavored membership records over the same ledger files.
+
+    The serving tier (`tpu_dp/serve/router.py`) reuses the training
+    ledger's record format and atomic-write discipline but not its
+    quiesce protocol: serving replicas are independent consumers of one
+    queue, so there is no collective to quiesce and no stop-step to
+    agree on — the router is the **single writer**, and an epoch is
+    simply "who is being fed right now". What carries over is what
+    matters for forensics: every drain, failure and rejoin is an
+    atomically-published `MembershipRecord` under
+    ``<membership_dir>/<generation>/epoch_NNNN.json``, the exact layout
+    ``obsctl timeline`` already reconstructs evictions and epochs from —
+    a serving preemption reads in the postmortem exactly like a training
+    one (docs/RESILIENCE.md "Failure matrix").
+
+    Departure reasons follow the training ledger's convention
+    (``preempted (graceful)`` for a drain, ``replica_failed: …`` for a
+    death); ``reason`` on the epoch record is ``serve_departure`` /
+    ``serve_rejoin`` so the two protocols stay distinguishable in one
+    timeline.
+    """
+
+    def __init__(self, membership_dir: str | os.PathLike,
+                 generation: str = "serve", sid: int = 0):
+        self.ledger = MembershipLedger(Path(membership_dir) / generation, sid)
+
+    def initial(self, members: Sequence[int]) -> MembershipRecord:
+        """Publish epoch 0 (idempotent — adopts an existing record)."""
+        return self.ledger.write_initial(members, None)
+
+    def current(self) -> MembershipRecord:
+        return self.ledger.current()
+
+    def depart(self, sid: int, reason: str) -> MembershipRecord:
+        """Publish the epoch without ``sid`` (drain or failure)."""
+        cur = self.ledger.current()
+        rec = MembershipRecord(
+            epoch=cur.epoch + 1,
+            members=tuple(m for m in cur.members if m != int(sid)),
+            coordinator=None,
+            departed=({"sid": int(sid), "reason": str(reason)},),
+            reason="serve_departure",
+            ts=time.time(),
+        )
+        out = self.ledger.publish_epoch(rec)
+        _counters.gauge("serve.membership_epoch", out.epoch)
+        return out
+
+    def rejoin(self, sid: int) -> MembershipRecord:
+        """Publish the epoch with ``sid`` back in the feed set."""
+        cur = self.ledger.current()
+        rec = MembershipRecord(
+            epoch=cur.epoch + 1,
+            members=tuple(sorted(set(cur.members) | {int(sid)})),
+            coordinator=None,
+            reason="serve_rejoin",
+            ts=time.time(),
+        )
+        out = self.ledger.publish_epoch(rec)
+        _counters.gauge("serve.membership_epoch", out.epoch)
+        return out
+
+
 class ElasticCoordinator:
     """Trainer-facing glue: ledger protocol + distributed-context surgery.
 

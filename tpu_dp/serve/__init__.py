@@ -1,20 +1,28 @@
-"""tpu_dp.serve — batched inference: queue → dynamic batcher → compiled
-forward (docs/SERVING.md).
+"""tpu_dp.serve — the self-healing serving tier: queue → dynamic batcher →
+replicated compiled forwards (docs/SERVING.md).
 
-The serving half of the "millions of users" north star (ROADMAP item 4),
+The serving half of the "millions of users" north star (ROADMAP item 3),
 built on the training stack's compiled-program discipline: requests enter
-a bounded deadline-aware `RequestQueue`, a `DynamicBatcher` coalesces them
-into zero-padded batches at fixed **bucket** sizes (a ladder like
-1/2/4/…/32, so every batch hits a pre-compiled `make_serve_step` program
-and the RecompileGuard stays silent), and an `InferenceEngine` dispatch
-thread runs the donated-buffer forward across the data-mesh replicas.
+a bounded, deadline- and **SLO-class**-aware `RequestQueue`, a
+`DynamicBatcher` coalesces them into zero-padded batches at fixed
+**bucket** sizes (a ladder like 1/2/4/…/32, so every batch hits a
+pre-compiled `make_serve_step` program and the RecompileGuard stays
+silent), and either a single-replica `InferenceEngine` or a fan-out
+`ServeCluster` of `ServeReplica` workers dispatches them — with
+heartbeat-derived health routing, failover with exactly-once accounting
+(`replica_failed` is a typed shed, never a silent drop), elastic
+drain/rejoin through the PR 7 membership-ledger format, and versioned hot
+weight swaps with zero dropped requests.
+
 Per-request latency is measured with `tpu_dp.obs` spans
 (queue_wait/batch_form/h2d/device/d2h), shed/SLO accounting lands in the
-process-wide counter registry, and the serve programs are fingerprinted in
-dplint's Level-3 artifact alongside the train steps.
+process-wide counter registry (with per-class twins), and the serve
+programs are fingerprinted in dplint's Level-3 artifact alongside the
+train steps.
 
 ``python -m tpu_dp.serve`` runs the synthetic-load CPU smoke
-(`tools/run_tier1.sh --serve` archives its report).
+(`tools/run_tier1.sh --serve` archives its report; ``--serve-elastic``
+runs the 2-replica chaos matrix).
 """
 
 from tpu_dp.serve.batcher import (
@@ -30,11 +38,14 @@ from tpu_dp.serve.queue import (
     SHED_CLOSED,
     SHED_DEADLINE,
     SHED_QUEUE_FULL,
+    SHED_REPLICA_FAILED,
     Request,
     RequestHandle,
     RequestQueue,
     ShedError,
 )
+from tpu_dp.serve.replica import LatencyBook, ServeReplica
+from tpu_dp.serve.router import ServeCluster
 
 __all__ = [
     "ARRIVAL_PATTERNS",
@@ -43,6 +54,7 @@ __all__ = [
     "DynamicBatcher",
     "FormedBatch",
     "InferenceEngine",
+    "LatencyBook",
     "Request",
     "RequestHandle",
     "RequestQueue",
@@ -50,6 +62,9 @@ __all__ = [
     "SHED_CLOSED",
     "SHED_DEADLINE",
     "SHED_QUEUE_FULL",
+    "SHED_REPLICA_FAILED",
+    "ServeCluster",
+    "ServeReplica",
     "ShedError",
     "arrival_offsets",
     "parse_buckets",

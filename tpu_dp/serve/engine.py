@@ -1,48 +1,93 @@
-"""The inference engine: per-bucket compiled forwards + the dispatch loop.
+"""The single-replica inference engine: queue → batcher → one ServeReplica.
 
-The serving half of the north star (ROADMAP item 4): the same compiled-
-program discipline the trainer enforces — fixed shapes, donated state, a
-fingerprinted collective schedule — applied to request traffic:
+The serving entry point for one process serving one model on one mesh —
+and, since the self-healing tier landed, a thin façade over the same
+`tpu_dp.serve.replica.ServeReplica` core the multi-replica
+`tpu_dp.serve.router.ServeCluster` fans out (docs/SERVING.md). The engine
+owns the admission edge (a `RequestQueue` with SLO classes and typed
+shedding) and the shared books (span recorder, per-class latency book);
+the replica owns the per-bucket compiled programs, the dispatch thread,
+heartbeats and fault injection. One code path serves both topologies, so
+the single-engine tests pin the exact dispatch semantics every cluster
+replica runs.
 
     submit() → RequestQueue → DynamicBatcher → per-bucket jitted
     `make_serve_step` → resolve handles
 
-One dispatch thread drains the queue. Every bucket in the ladder gets its
-own pre-compiled program (warmed up at `start`), wrapped in a
-`RecompileGuard` with ``on_retrace="raise"`` by default: a retrace during
-serving means a shape/dtype leaked past the batcher, and the engine treats
-that as a bug, not a slow path. The params/batch_stats live in a
-`TrainState` with an *empty* opt_state (`checkpoint.load_params_only` —
-inference never materializes optimizer slots); the device-mesh replicas
-give batch fan-out for free (see `make_serve_step`).
+Every bucket in the ladder gets its own pre-compiled program (warmed up at
+`start`), wrapped in a `RecompileGuard` with ``on_retrace="raise"`` by
+default: a retrace during serving means a shape/dtype leaked past the
+batcher, and the engine treats that as a bug, not a slow path. The
+params/batch_stats live in a `TrainState` with an *empty* opt_state
+(`checkpoint.load_params_only` — inference never materializes optimizer
+slots); the device-mesh replicas give batch fan-out for free
+(see `make_serve_step`). `swap_model` hot-swaps a new weight version
+between batches — zero dropped requests, every response stamped with the
+version that served it.
 
 Telemetry (docs/OBSERVABILITY.md, docs/SERVING.md): per-request spans
 ``queue_wait / batch_form / h2d / device / d2h`` (+ ``total``) in a
 `SpanRecorder`; counters ``serve.accepted / serve.shed[.reason] /
-serve.completed / serve.deadline_missed / serve.batches`` and the
-``serve.batch_occupancy`` gauge in the process-wide registry; per-batch
-heartbeats via `HeartbeatWriter` when ``obs_dir`` is set, so a straggling
-serve rank is attributable with the exact `HealthMonitor` tooling the
-trainer uses. The deterministic fault injector (``TPU_DP_FAULT=delay:…``)
-is consulted per batch inside the device span, so injected stragglers
-surface in spans and heartbeats like real ones.
+serve.completed / serve.deadline_missed / serve.batches`` (+ per-class
+``.c<k>`` twins) and the ``serve.batch_occupancy`` gauge in the
+process-wide registry; per-batch heartbeats via `HeartbeatWriter` when
+``obs_dir`` is set, so a straggling serve rank is attributable with the
+exact `HealthMonitor` tooling the trainer uses. The deterministic fault
+injector (``TPU_DP_FAULT=delay:…``) is consulted per batch inside the
+device span, so injected stragglers surface in spans and heartbeats like
+real ones.
 """
 
 from __future__ import annotations
-
-import threading
-import time
 
 import numpy as np
 
 from tpu_dp.obs.counters import Counters, counters as _global_counters
 from tpu_dp.obs.spans import SpanRecorder
-from tpu_dp.serve.batcher import BucketLadder, DynamicBatcher, FormedBatch
-from tpu_dp.serve.queue import SHED_CLOSED, RequestHandle, RequestQueue
+from tpu_dp.serve.batcher import BucketLadder
+from tpu_dp.serve.queue import (
+    SHED_CLOSED, RequestHandle, RequestQueue, shed_counted,
+)
+from tpu_dp.serve.replica import SERVE_SPANS, LatencyBook, ServeReplica
 
-#: per-request span names, in pipeline order (the serving analogue of
-#: `tpu_dp.obs.spans.STEP_SPANS`).
-SERVE_SPANS = ("queue_wait", "batch_form", "h2d", "device", "d2h")
+__all__ = ["SERVE_SPANS", "InferenceEngine", "register_serve_costs"]
+
+
+def register_serve_costs(ladder: BucketLadder, world: int,
+                         model_name: str = "",
+                         flops_per_image: float | None = None
+                         ) -> dict[int, float]:
+    """Per-bucket serve FLOPs: registered in the shared cost registry AND
+    returned for the replicas' own utilization gauges.
+
+    Forward-only FLOPs per image (analytic, ~training/3) times the
+    bucket, per chip — world-divisible buckets shard the batch over the
+    mesh, sub-world buckets run replicated (every chip computes the full
+    bucket). Unknown models publish nothing: absence means "not
+    measured", never a fake number. The returned dict (bucket → per-chip
+    FLOPs) is what each replica computes its gauges from: the registry
+    entry is introspection metadata, and two topologies with different
+    per-replica worlds in one process (engine + cluster) must not
+    corrupt each other's live gauges through the shared key.
+    """
+    from tpu_dp.obs import costs as _costs
+
+    if flops_per_image is None and model_name:
+        flops_per_image = _costs.serve_flops_per_image(model_name)
+    if not flops_per_image:
+        return {}
+    out: dict[int, float] = {}
+    for b in ladder.buckets:
+        per_chip = (
+            float(flops_per_image) * b / world
+            if b % world == 0 else float(flops_per_image) * b
+        )
+        out[b] = per_chip
+        _costs.registry.register(
+            f"serve_step@b{b}", per_chip,
+            source="analytic", check="unverified",
+        )
+    return out
 
 
 class InferenceEngine:
@@ -70,15 +115,11 @@ class InferenceEngine:
         model_name: str = "",
         flops_per_image: float | None = None,
         peak_flops: float | None = None,
+        class_slo_ms: dict[int, float] | None = None,
     ):
         import jax
 
         from tpu_dp.parallel import dist
-        from tpu_dp.parallel.sharding import (
-            batch_sharding, replicated_sharding,
-        )
-        from tpu_dp.resilience.faultinject import FaultInjector
-        from tpu_dp.train.state import TrainState
 
         self.model = model
         self.mesh = dist.data_mesh() if mesh is None else mesh
@@ -86,6 +127,7 @@ class InferenceEngine:
             buckets if buckets is not None else BucketLadder().buckets
         )
         self.slo_ms = float(slo_ms)
+        self.class_slo_ms = dict(class_slo_ms or {})
         self._counters = _global_counters if registry is None else registry
         self.queue = RequestQueue(
             max_depth=max_queue,
@@ -96,156 +138,118 @@ class InferenceEngine:
             max_request=self.ladder.max_batch,
             registry=self._counters,
         )
-        self.batcher = DynamicBatcher(self.queue, self.ladder,
-                                      max_wait_ms=max_wait_ms)
         self.recorder = SpanRecorder(capacity=span_capacity)
-
-        # Inference state: params (+ BN stats) only, replicated, never
-        # donated. The empty opt_state is the point — serving a checkpoint
-        # must not pay for (or even know about) optimizer slots.
-        repl = replicated_sharding(self.mesh)
-        state = TrainState(
-            step=np.zeros((), np.int32),
-            params=params,
-            opt_state={},
-            batch_stats=batch_stats or {},
-        )
-        self._state = jax.device_put(state, repl)
-        if num_classes is None:
-            from tpu_dp.train.step import _infer_forward
-
-            probe = np.zeros((1,) + tuple(image_shape), np.dtype(image_dtype))
-            shapes = jax.eval_shape(
-                lambda s, b: _infer_forward(model, s, b),
-                self._state, {"image": probe},
-            )
-            num_classes = int(shapes[0].shape[-1])
-        self.num_classes = int(num_classes)
-
-        from tpu_dp.train.step import init_serve_stats
-
-        self._stats = jax.device_put(
-            init_serve_stats(self.num_classes), repl
-        )
-        self._repl = repl
-        self._batch_sharding = {
-            b: (batch_sharding(self.mesh)
-                if b % dist.data_axis_size(self.mesh) == 0 else repl)
-            for b in self.ladder.buckets
-        }
-        self._programs: dict[int, object] = {}
-        self._on_retrace = on_retrace
-        self._fault = FaultInjector.from_spec(fault, rank=jax.process_index())
-        self._hb = None
+        self.latency_book = LatencyBook(capacity=span_capacity)
+        hb = None
         if obs_dir:
             from tpu_dp.obs.health import HeartbeatWriter
 
-            self._hb = HeartbeatWriter(obs_dir, rank=jax.process_index())
-        self._thread: threading.Thread | None = None
-        self._stop = threading.Event()
-        self._error: BaseException | None = None
-        self._batch_index = 0
-        self._bucket_counts: dict[int, int] = {}
-        self._lock = threading.Lock()  # report() vs dispatch-thread state
+            hb = HeartbeatWriter(obs_dir, rank=jax.process_index())
+        bucket_flops = register_serve_costs(
+            self.ladder, dist.data_axis_size(self.mesh),
+            model_name=model_name, flops_per_image=flops_per_image,
+        )
+        self.replica = ServeReplica(
+            sid=0,
+            model=model,
+            params=params,
+            batch_stats=batch_stats,
+            mesh=self.mesh,
+            ladder=self.ladder,
+            queue=self.queue,
+            recorder=self.recorder,
+            latency_book=self.latency_book,
+            max_wait_ms=max_wait_ms,
+            num_classes=num_classes,
+            on_retrace=on_retrace,
+            fault=fault,
+            fault_rank=jax.process_index(),
+            hb=hb,
+            router=None,
+            peak_flops=peak_flops,
+            bucket_flops=bucket_flops,
+            registry=self._counters,
+        )
+        self.batcher = self.replica.batcher
+        self.num_classes = self.replica.num_classes
+        self._published_version = self.replica.model_version
 
-        # Per-bucket device-utilization accounting from the SAME cost
-        # registry the trainer's MFU gauges use (tpu_dp/obs/costs.py):
-        # forward-only FLOPs per image (analytic, ~training/3) times the
-        # bucket, per chip — world-divisible buckets shard the batch over
-        # the mesh, sub-world buckets run replicated (every chip computes
-        # the full bucket). Unknown models/chips publish nothing: absence
-        # means "not measured", never a fake number.
-        from tpu_dp.obs import costs as _costs
+    # -- replica delegation (the façade's seams) -------------------------
 
-        if flops_per_image is None and model_name:
-            flops_per_image = _costs.serve_flops_per_image(model_name)
-        self._peak = peak_flops
-        if self._peak is None:
-            try:
-                self._peak = _costs.peak_flops(
-                    jax.devices()[0].device_kind
-                )
-            except Exception:
-                self._peak = None
-        if flops_per_image:
-            world = dist.data_axis_size(self.mesh)
-            for b in self.ladder.buckets:
-                per_chip = (
-                    float(flops_per_image) * b / world
-                    if b % world == 0 else float(flops_per_image) * b
-                )
-                _costs.registry.register(
-                    f"serve_step@b{b}", per_chip,
-                    source="analytic", check="unverified",
-                )
+    @property
+    def _programs(self) -> dict:
+        return self.replica._programs
 
-    # -- programs --------------------------------------------------------
+    @property
+    def _stats(self):
+        return self.replica._stats
 
-    def _program(self, bucket: int):
-        from tpu_dp.analysis.recompile import RecompileGuard
-        from tpu_dp.train.step import make_serve_step
+    @property
+    def _lock(self):
+        return self.replica._lock
 
-        prog = self._programs.get(bucket)
-        if prog is None:
-            prog = RecompileGuard(
-                make_serve_step(self.model, self.mesh, bucket),
-                name=f"serve_step@b{bucket}",
-                warmup_calls=1,
-                on_retrace=self._on_retrace,
-            )
-            self._programs[bucket] = prog
-        return prog
+    @property
+    def _hb(self):
+        return self.replica._hb
+
+    @property
+    def model_version(self) -> int:
+        return self.replica.model_version
 
     def warmup(self) -> dict[int, float]:
-        """Compile + run every bucket program once; per-bucket wall ms.
-
-        After this, the acceptance bar is ZERO retraces for the rest of
-        the engine's life (`retraces` property; the guards raise by
-        default). Warmup batches are all-padding (weight 0), so the
-        device stats count nothing.
-        """
-        import jax
-
-        times: dict[int, float] = {}
-        for bucket in self.ladder.buckets:
-            t0 = time.perf_counter()
-            # Placed exactly like the live path (`_place_batch`): a warmup
-            # call whose argument signature differs from production calls
-            # would leave the real first request paying the compile.
-            batch = self._place_batch(
-                bucket,
-                np.zeros((bucket,) + self.queue.image_shape,
-                         self.queue.image_dtype),
-                np.zeros((bucket,), np.float32),
-            )
-            self._stats, out = self._program(bucket)(
-                self._stats, self._state, batch
-            )
-            jax.block_until_ready(out)
-            times[bucket] = round((time.perf_counter() - t0) * 1e3, 2)
-        return times
+        """Compile + run every bucket program once; per-bucket wall ms
+        (`ServeReplica.warmup`)."""
+        return self.replica.warmup()
 
     @property
     def retraces(self) -> int:
         """Post-warmup retraces across every bucket program (must stay 0)."""
-        return sum(g.retraces for g in self._programs.values())
+        return self.replica.retraces
 
     def guard_stats(self) -> list[dict]:
-        return [g.stats() for _, g in sorted(self._programs.items())]
+        return self.replica.guard_stats()
+
+    def device_stats(self) -> dict:
+        """The donated stats pytree, fetched: device-side ground truth."""
+        return self.replica.device_stats()
+
+    # -- hot swap --------------------------------------------------------
+
+    def swap_model(self, params, batch_stats=None,
+                   version: int | None = None) -> int:
+        """Hot-swap the served weights in place, between batches.
+
+        Zero dropped requests by construction: the dispatch loop applies
+        the swap only at a batch boundary, and every response carries the
+        ``model_version`` that actually served it. Returns the version
+        now pending (applied before the next dispatched batch). Versions
+        count PUBLISHED swaps, not applied ones: two swaps landing
+        between the same pair of batches still get distinct stamps.
+        """
+        self._published_version = (self._published_version + 1
+                                   if version is None else int(version))
+        self.replica.set_pending_state(params, batch_stats,
+                                       self._published_version)
+        return self._published_version
+
+    def swap_from_checkpoint(self, ckpt_dir,
+                             version: int | None = None) -> int:
+        """`swap_model` from a training checkpoint (params-only load —
+        optimizer state and error-feedback residuals never materialize)."""
+        params, batch_stats, _ = _load_swap_checkpoint(
+            ckpt_dir, self.model, self.queue.image_shape
+        )
+        return self.swap_model(params, batch_stats, version=version)
 
     # -- lifecycle -------------------------------------------------------
 
     def start(self, warmup: bool = True) -> "InferenceEngine":
         """Warm the bucket programs and launch the dispatch thread."""
-        if self._thread is not None:
+        if self.replica.status == "running":
             raise RuntimeError("engine already started")
         if warmup:
-            self.warmup()
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._loop, name="tpu_dp-serve-dispatch", daemon=True
-        )
-        self._thread.start()
+            self.replica.warmup()
+        self.replica.start()
         return self
 
     def stop(self, drain: bool = True) -> None:
@@ -259,21 +263,17 @@ class InferenceEngine:
         """
         self.queue.close()
         if not drain:
-            self._stop.set()
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
+            self.replica.stop_now()
+        self.replica.join()
         if not drain:
             # Abandoned requests must not leave callers blocked forever.
             reqs, _ = self.queue.collect(self.ladder.max_batch * 10**6)
             for req in reqs:
-                self._counters.inc("serve.shed")
-                self._counters.inc(f"serve.shed.{SHED_CLOSED}")
-                req.handle._shed(SHED_CLOSED)
-        if self._hb is not None:
-            self._hb.close()
-        if self._error is not None:
-            err, self._error = self._error, None
+                shed_counted(self._counters, req.handle, SHED_CLOSED)
+        if self.replica._hb is not None:
+            self.replica._hb.close()
+        err = self.replica.take_error()
+        if err is not None:
             raise RuntimeError("serve dispatch thread failed") from err
 
     def __enter__(self):
@@ -284,174 +284,18 @@ class InferenceEngine:
 
     # -- producer API ----------------------------------------------------
 
-    def submit(self, images, slo_ms: float | None = None) -> RequestHandle:
-        """Enqueue one request (see `RequestQueue.submit`); may shed."""
-        return self.queue.submit(images, slo_ms=slo_ms)
+    def submit(self, images, slo_ms: float | None = None,
+               slo_class: int = 0) -> RequestHandle:
+        """Enqueue one request (see `RequestQueue.submit`); may shed.
 
-    def _place_batch(self, bucket: int, images: np.ndarray,
-                     weight: np.ndarray):
-        """Host batch → device, under the bucket's sharding (one path for
-        warmup and live dispatch, so their jit signatures cannot differ)."""
-        import jax
-
-        sh = self._batch_sharding[bucket]
-        return jax.device_put(
-            {"image": images, "weight": weight},
-            {"image": sh, "weight": sh},
-        )
-
-    # -- the dispatch loop ----------------------------------------------
-
-    def _loop(self) -> None:
-        batch = None
-        try:
-            while True:
-                if self._stop.is_set():  # abandon mode: stop(drain=False)
-                    return
-                batch = self.batcher.next_batch(timeout_s=0.05)
-                if batch == "closed":
-                    return
-                if batch == "timeout":
-                    continue
-                if self._stop.is_set():
-                    # Abandon a batch formed while stopping — its popped
-                    # requests go back through the shed-on-close path.
-                    for req in batch.requests:
-                        self._counters.inc("serve.shed")
-                        self._counters.inc(f"serve.shed.{SHED_CLOSED}")
-                        req.handle._shed(SHED_CLOSED)
-                    return
-                self._run_batch(batch)
-                batch = None
-        except BaseException as e:  # surfaced by stop()
-            self._error = e
-            # Neither the in-flight batch's requests (already popped) nor
-            # anything still queued may wait forever on a dead loop.
-            self.queue.close()
-            pending = list(batch.requests) if isinstance(batch, FormedBatch) \
-                else []
-            reqs, _ = self.queue.collect(self.ladder.max_batch * 10**6)
-            pending.extend(reqs)
-            for req in pending:
-                if not req.handle.done():
-                    self._counters.inc("serve.shed")
-                    self._counters.inc("serve.shed.engine_error")
-                    req.handle._shed("engine_error")
-
-    def _run_batch(self, batch: FormedBatch) -> None:
-        import jax
-
-        # Expired handles were resolved (shed) by the queue; nothing to
-        # serve in an all-expired wake.
-        if not batch.requests:
-            return
-        t0 = time.perf_counter()
-        dev_batch = self._place_batch(batch.bucket, batch.images,
-                                      batch.weight)
-        jax.block_until_ready(dev_batch)
-        t1 = time.perf_counter()
-        with self._lock:
-            # The donated stats buffer is consumed by the call below, so
-            # report()/device_stats() must never read `self._stats` while
-            # a dispatch is in flight — the lock brackets consumption and
-            # reassignment as one atomic step.
-            if self._fault is not None:
-                # Deterministic straggler/kill injection, bracketed inside
-                # the device span so an injected delay is attributed
-                # exactly like a real slow device (tests/test_serve.py).
-                self._fault.on_step(self._batch_index)
-            self._stats, out = self._program(batch.bucket)(
-                self._stats, self._state, dev_batch
-            )
-            jax.block_until_ready(out)
-        t2 = time.perf_counter()
-        predictions = np.asarray(out["prediction"])
-        confidence = np.asarray(out["confidence"])
-        t3 = time.perf_counter()
-
-        h2d_ms = (t1 - t0) * 1e3
-        device_ms = (t2 - t1) * 1e3
-        d2h_ms = (t3 - t2) * 1e3
-        resolutions = []
-        missed = 0
-        with self._lock:
-            for req, sl in zip(batch.requests, batch.slices):
-                latency_ms = (t3 - req.arrival) * 1e3
-                deadline_missed = t3 > req.deadline
-                missed += int(deadline_missed)
-                spans = {
-                    "queue_wait": max(
-                        0.0,
-                        (batch.formed - req.arrival) * 1e3 - batch.form_ms,
-                    ),
-                    "batch_form": batch.form_ms,
-                    "h2d": h2d_ms,
-                    "device": device_ms,
-                    "d2h": d2h_ms,
-                    "total": latency_ms,
-                }
-                self.recorder.record(req.req_id, spans, ts=req.arrival_ts)
-                resolutions.append(
-                    (req, sl, latency_ms, deadline_missed, spans)
-                )
-            self._bucket_counts[batch.bucket] = (
-                self._bucket_counts.get(batch.bucket, 0) + 1
-            )
-            self._batch_index += 1
-        # Publish counters BEFORE waking any waiter: a caller whose last
-        # handle just resolved must read books that already include it
-        # (the loadgen's exact-consistency audit depends on this order).
-        self._counters.inc("serve.batches")
-        self._counters.inc("serve.completed", len(batch.requests))
-        if missed:
-            self._counters.inc("serve.deadline_missed", missed)
-        self._counters.gauge("serve.batch_occupancy", batch.occupancy)
-        # Per-device HBM gauges from the dispatch loop — serving was the
-        # one workload flying blind on device memory (the trainer already
-        # publishes these per window). Backends without memory stats
-        # publish nothing.
-        from tpu_dp.obs.counters import update_device_memory_gauges
-
-        update_device_memory_gauges(registry=self._counters)
-        # Per-bucket device utilization from the shared cost registry:
-        # the fraction of the chip's peak this dispatch's forward used.
-        from tpu_dp.obs import costs as _costs
-        from tpu_dp.obs import flightrec as _flightrec
-
-        util = _costs.registry.utilization(
-            f"serve_step@b{batch.bucket}", 1, device_ms / 1e3, self._peak
-        )
-        if util is not None:
-            self._counters.gauge(f"serve.device_util.b{batch.bucket}",
-                                 round(util, 4))
-            self._counters.gauge("serve.device_util", round(util, 4))
-        _flightrec.record(
-            "serve_dispatch", bucket=batch.bucket,
-            n=len(batch.requests), occupancy=batch.occupancy,
-            device_ms=round(device_ms, 3), deadline_missed=missed,
-        )
-        if self._hb is not None:
-            self._hb.beat(
-                step=self._batch_index,
-                step_ms=batch.form_ms + (t3 - t0) * 1e3,
-            )
-        for req, sl, latency_ms, deadline_missed, spans in resolutions:
-            req.handle._resolve(
-                predictions[sl].copy(), confidence[sl].copy(),
-                latency_ms, deadline_missed, spans,
-            )
+        ``slo_class`` picks the priority tier (0 = highest); its default
+        latency budget comes from ``class_slo_ms`` when configured.
+        """
+        if slo_ms is None:
+            slo_ms = self.class_slo_ms.get(int(slo_class))
+        return self.queue.submit(images, slo_ms=slo_ms, slo_class=slo_class)
 
     # -- reporting -------------------------------------------------------
-
-    def device_stats(self) -> dict:
-        """The donated stats pytree, fetched: device-side ground truth."""
-        with self._lock:
-            served = np.asarray(self._stats["served"])
-            counts = np.asarray(self._stats["class_counts"])
-        return {
-            "served": int(served),
-            "class_counts": [int(c) for c in counts],
-        }
 
     def report(self) -> dict:
         """SLO attainment + latency percentiles + shed/bucket accounting.
@@ -460,58 +304,36 @@ class InferenceEngine:
         request's ``total`` span is its end-to-end latency, and SLO
         attainment is the fraction of *completed* requests within
         ``slo_ms`` (shed requests are reported separately — a shed is an
-        explicit rejection, not a silent miss). The recorder is a ring
-        (``span_capacity`` requests), so on a long-lived engine these are
-        the statistics of the most recent window — bounded memory by
-        design, like the trainer's span ring.
+        explicit rejection, not a silent miss). ``classes`` is the
+        per-SLO-class twin (attainment vs each class's own target). The
+        recorder is a ring (``span_capacity`` requests), so on a
+        long-lived engine these are the statistics of the most recent
+        window — bounded memory by design, like the trainer's span ring.
         """
-        from tpu_dp.obs.spans import percentile
+        from tpu_dp.serve.replica import serve_report_core
 
-        with self._lock:
-            buckets = dict(sorted(self._bucket_counts.items()))
-            n_batches = self._batch_index
-            lat = sorted(
-                rec["spans"]["total"] for rec in self.recorder.records()
-            )
-            # Under the same lock as record(): a rollup while the dispatch
-            # thread appends would iterate a mutating deque.
-            rollup = self.recorder.rollup()
-        latency = None
-        attainment = None
-        if lat:
-            latency = {
-                "p50_ms": round(percentile(lat, 50), 3),
-                "p95_ms": round(percentile(lat, 95), 3),
-                "p99_ms": round(percentile(lat, 99), 3),
-                "mean_ms": round(sum(lat) / len(lat), 3),
-                "max_ms": round(lat[-1], 3),
-                "n": len(lat),
-            }
-            attainment = round(
-                sum(1 for v in lat if v <= self.slo_ms) / len(lat), 4
-            )
-        snap = self._counters.snapshot()
-        return {
-            "slo": {"target_ms": self.slo_ms, "attainment": attainment},
-            "latency_ms": latency,
-            "spans": {k: v for k, v in rollup.items() if k != "total"},
-            "counters": {k: v for k, v in sorted(snap.items())
-                         if k.startswith("serve.")},
-            "batches": n_batches,
-            "bucket_counts": buckets,
-            "occupancy": snap.get("serve.batch_occupancy"),
-            "device_util": snap.get("serve.device_util"),
+        out = serve_report_core(
+            self.recorder, self.latency_book, self.replica._books_lock,
+            self.class_slo_ms, self.slo_ms, self._counters,
+        )
+        snap_replica = self.replica.snapshot()
+        out.update({
+            "batches": snap_replica["batches"],
+            "bucket_counts": snap_replica["bucket_counts"],
             "retraces": self.retraces,
             "guards": self.guard_stats(),
             "device_stats": self.device_stats(),
+            "model_version": self.replica.model_version,
             "world": int(self.mesh.devices.size),
-        }
+        })
+        return out
 
     # -- constructors ----------------------------------------------------
 
     @classmethod
     def from_serve_config(cls, model, params, serve_cfg, **kwargs):
         """Build from a `tpu_dp.config.ServeConfig` section."""
+        from tpu_dp.config import parse_class_slo_ms
         from tpu_dp.serve.batcher import parse_buckets
 
         return cls(
@@ -522,6 +344,7 @@ class InferenceEngine:
             slo_ms=serve_cfg.slo_ms,
             shed_headroom_ms=serve_cfg.shed_headroom_ms,
             obs_dir=serve_cfg.obs_dir or None,
+            class_slo_ms=parse_class_slo_ms(serve_cfg.class_slo_ms),
             **kwargs,
         )
 
@@ -532,49 +355,79 @@ class InferenceEngine:
         ``ckpt_dir`` is either one ``step_*`` checkpoint directory or a
         `CheckpointManager` root (its newest complete checkpoint is
         used). The model is rebuilt from the checkpoint's recorded config
-        when not passed. Optimizer state is never materialized
-        (`checkpoint.load_params_only`), so a checkpoint written under
-        any world size or ``train.update_sharding`` mode serves
-        unchanged.
+        when not passed. Optimizer state is never materialized — and a
+        post-PR-10 int8-trained checkpoint's error-feedback residuals are
+        dropped the same way (`checkpoint.load_params_only`) — so a
+        checkpoint written under any world size, ``train.update_sharding``
+        mode, or ``train.collective_dtype`` serves unchanged.
         """
-        import json
-        from pathlib import Path
-
-        import jax
-
-        from tpu_dp.checkpoint import CheckpointManager, load_params_only
-        from tpu_dp.models import build_model
-
-        ckpt_dir = Path(ckpt_dir)
-        if not (ckpt_dir / "state.msgpack").exists():
-            latest = CheckpointManager(ckpt_dir).latest_dir()
-            if latest is None:
-                raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
-            ckpt_dir = latest
-        meta_path = ckpt_dir / "meta.json"
-        meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
-        cfg = meta.get("config", {})
-        if model is None:
-            model_cfg = cfg.get("model", {})
-            name = model_cfg.get("name", "net")
-            num_classes = model_cfg.get("num_classes") or (
-                100 if cfg.get("data", {}).get("dataset") == "cifar100"
-                else 10
-            )
-            model = build_model(name, num_classes=num_classes)
-            # The checkpoint names the model, so the per-bucket
-            # device-utilization gauges come for free.
+        model, params, batch_stats, name = _resolve_checkpoint(
+            ckpt_dir, model, kwargs.get("image_shape", (32, 32, 3))
+        )
+        if name:
             kwargs.setdefault("model_name", name)
-        image_shape = kwargs.get("image_shape", (32, 32, 3))
-        variables = model.init(
-            jax.random.PRNGKey(0),
-            np.zeros((1,) + tuple(image_shape), np.float32),
-            train=False,
-        )
-        params, batch_stats, _ = load_params_only(
-            ckpt_dir,
-            variables["params"],
-            target_batch_stats=variables.get("batch_stats") or None,
-        )
         return cls(model, params, batch_stats=batch_stats, mesh=mesh,
                    **kwargs)
+
+
+def _resolve_ckpt_dir(ckpt_dir):
+    """One ``step_*`` checkpoint directory, or a `CheckpointManager` root
+    resolved to its newest complete checkpoint — every serve-side loader
+    (initial load AND hot swap) accepts both."""
+    from pathlib import Path
+
+    from tpu_dp.checkpoint import CheckpointManager
+
+    ckpt_dir = Path(ckpt_dir)
+    if not (ckpt_dir / "state.msgpack").exists():
+        latest = CheckpointManager(ckpt_dir).latest_dir()
+        if latest is None:
+            raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+        ckpt_dir = latest
+    return ckpt_dir
+
+
+def _resolve_checkpoint(ckpt_dir, model, image_shape):
+    """(model, params, batch_stats, model_name) from a training checkpoint
+    dir or CheckpointManager root — the shared loader behind
+    `InferenceEngine.from_checkpoint` and `ServeCluster.from_checkpoint`."""
+    import json
+
+    from tpu_dp.models import build_model
+
+    ckpt_dir = _resolve_ckpt_dir(ckpt_dir)
+    meta_path = ckpt_dir / "meta.json"
+    meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
+    cfg = meta.get("config", {})
+    name = ""
+    if model is None:
+        model_cfg = cfg.get("model", {})
+        name = model_cfg.get("name", "net")
+        num_classes = model_cfg.get("num_classes") or (
+            100 if cfg.get("data", {}).get("dataset") == "cifar100"
+            else 10
+        )
+        model = build_model(name, num_classes=num_classes)
+    params, batch_stats, _ = _load_swap_checkpoint(
+        ckpt_dir, model, image_shape
+    )
+    return model, params, batch_stats, name
+
+
+def _load_swap_checkpoint(ckpt_dir, model, image_shape):
+    """Params-only restore against a fresh init of ``model`` (accepts a
+    step dir or a CheckpointManager root, like `from_checkpoint`)."""
+    import jax
+
+    from tpu_dp.checkpoint import load_params_only
+
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        np.zeros((1,) + tuple(image_shape), np.float32),
+        train=False,
+    )
+    return load_params_only(
+        _resolve_ckpt_dir(ckpt_dir),
+        variables["params"],
+        target_batch_stats=variables.get("batch_stats") or None,
+    )

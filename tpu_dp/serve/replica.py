@@ -1,0 +1,754 @@
+"""One serving replica: per-bucket compiled forwards + a dispatch worker.
+
+The unit the self-healing serving tier is built from (docs/SERVING.md
+"Replica fan-out"). A `ServeReplica` owns everything that is *per-replica*
+— a device mesh (a subset of the host's devices under fan-out, the whole
+mesh for a single-replica `InferenceEngine`), the per-bucket pre-compiled
+`make_serve_step` programs behind RecompileGuards, the versioned inference
+state, the donated device stats, a heartbeat writer, and its fault
+injectors — and runs one dispatch thread that pulls padded batches from a
+**shared** `RequestQueue`.
+
+What it deliberately does NOT own: the queue (shared admission — the
+router's, or the engine's), the span recorder and per-class latency book
+(shared books: the audit is cluster-wide), and the health/failover policy.
+A replica reports *facts* (heartbeats, in-flight age, errors); the router
+(`tpu_dp/serve/router.py`) decides what they mean. With ``router=None``
+the replica degrades to the original single-engine behavior: a dispatch
+failure sheds everything ``engine_error`` and closes the queue, because
+there is nobody to fail over to.
+
+Lifecycle states (``status``): ``idle`` → ``running`` → one of
+``stopped`` (queue drained/closed), ``left`` (drain-then-leave — elastic
+departure; `start` again to rejoin without recompiling anything), or
+``dead`` (dispatch raised; the router retried/shed its in-flight batch).
+
+Hot swap: `set_pending_state` parks a new (device-placed) state + version;
+the dispatch loop swaps it in **between batches** — never mid-batch, so
+every response is stamped with exactly the ``model_version`` that computed
+it and zero requests are dropped by an upgrade.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from tpu_dp.obs.counters import Counters, counters as _global_counters
+from tpu_dp.obs.spans import SpanRecorder, percentile
+from tpu_dp.serve.batcher import BucketLadder, DynamicBatcher, FormedBatch
+from tpu_dp.serve.queue import (
+    SHED_CLOSED,
+    RequestQueue,
+    shed_counted,
+)
+
+#: per-request span names, in pipeline order (the serving analogue of
+#: `tpu_dp.obs.spans.STEP_SPANS`).
+SERVE_SPANS = ("queue_wait", "batch_form", "h2d", "device", "d2h")
+
+#: fault kinds consulted INSIDE the device span (they simulate a slow or
+#: corrupt device) vs at the loop top (process/membership events).
+_DEVICE_FAULT_KINDS = ("delay",)
+_LOOP_FAULT_KINDS = ("leave", "preempt", "kill")
+
+
+def parse_fault_specs(spec: str, rank: int):
+    """';'-separated fault specs → one injector per plan for ``rank``.
+
+    The single-spec grammar is `tpu_dp.resilience.faultinject`'s; the
+    semicolon list exists because a chaos scenario poisons one replica
+    with ``delay:`` while another gets ``leave:`` in the same run. Empty
+    spec falls back to ``TPU_DP_FAULT`` (same as the single-spec path).
+    """
+    from tpu_dp.resilience.faultinject import FaultInjector, FaultPlan
+
+    spec = spec or os.environ.get("TPU_DP_FAULT", "")
+    out = []
+    for part in spec.split(";"):
+        plan = FaultPlan.parse(part.strip())
+        if plan is not None:
+            out.append(FaultInjector(plan, rank=rank))
+    return out
+
+
+class LatencyBook:
+    """Shared per-SLO-class completed-request latencies (bounded rings).
+
+    One per engine/cluster, appended by every replica under the shared
+    books lock; `rollup` turns it into the per-class attainment block the
+    serve report and ``obsctl diff`` gate on. Bounded like the span ring:
+    long-lived servers report the statistics of the recent window.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = int(capacity)
+        self._lat: dict[int, deque] = {}
+
+    def note(self, slo_class: int, latency_ms: float) -> None:
+        dq = self._lat.get(int(slo_class))
+        if dq is None:
+            dq = self._lat.setdefault(
+                int(slo_class), deque(maxlen=self.capacity)
+            )
+        dq.append(float(latency_ms))
+
+    def classes(self) -> list[int]:
+        return sorted(self._lat)
+
+    def rollup(self, slo_ms_by_class: dict[int, float],
+               default_slo_ms: float) -> dict[str, dict]:
+        """Per-class latency percentiles + attainment vs the class target.
+
+        Keys are stringified class ids (JSON-stable). ``attainment`` is
+        the fraction of completed requests within the class's SLO —
+        sheds are accounted separately (explicit rejection ≠ silent
+        miss), exactly like the engine-level attainment.
+        """
+        out: dict[str, dict] = {}
+        for cls in self.classes():
+            lat = sorted(self._lat[cls])
+            if not lat:
+                continue
+            target = float(slo_ms_by_class.get(cls, default_slo_ms))
+            out[str(cls)] = {
+                "slo_ms": target,
+                "attainment": round(
+                    sum(1 for v in lat if v <= target) / len(lat), 4
+                ),
+                "p50_ms": round(percentile(lat, 50), 3),
+                "p95_ms": round(percentile(lat, 95), 3),
+                "mean_ms": round(sum(lat) / len(lat), 3),
+                "n": len(lat),
+            }
+        return out
+
+
+def serve_report_core(recorder: SpanRecorder, latency_book: LatencyBook,
+                      books_lock: threading.Lock,
+                      class_slo_ms: dict[int, float], slo_ms: float,
+                      registry: Counters) -> dict:
+    """The report keys shared by `InferenceEngine` and `ServeCluster` —
+    one rollup implementation, so the single-replica and fan-out reports
+    cannot drift. Overall SLO attainment and latency percentiles come
+    from the shared span ring, per-class attainment from the latency
+    book, both read under the shared books lock (a rollup racing a
+    dispatch thread's append would iterate a mutating deque)."""
+    with books_lock:
+        lat = sorted(
+            rec["spans"]["total"] for rec in recorder.records()
+        )
+        rollup = recorder.rollup()
+        classes = latency_book.rollup(class_slo_ms, slo_ms)
+    latency = None
+    attainment = None
+    if lat:
+        latency = {
+            "p50_ms": round(percentile(lat, 50), 3),
+            "p95_ms": round(percentile(lat, 95), 3),
+            "p99_ms": round(percentile(lat, 99), 3),
+            "mean_ms": round(sum(lat) / len(lat), 3),
+            "max_ms": round(lat[-1], 3),
+            "n": len(lat),
+        }
+        attainment = round(
+            sum(1 for v in lat if v <= slo_ms) / len(lat), 4
+        )
+    snap = registry.snapshot()
+    return {
+        "slo": {"target_ms": slo_ms, "attainment": attainment},
+        "latency_ms": latency,
+        "spans": {k: v for k, v in rollup.items() if k != "total"},
+        "classes": classes,
+        "counters": {k: v for k, v in sorted(snap.items())
+                     if k.startswith("serve.")},
+        "occupancy": snap.get("serve.batch_occupancy"),
+        "device_util": snap.get("serve.device_util"),
+    }
+
+
+class ServeReplica:
+    """One replica's compiled programs + dispatch worker (module docstring).
+
+    ``params``/``batch_stats`` are host (or any-layout) pytrees; the
+    replica places them replicated over its own ``mesh``. ``queue``,
+    ``recorder``, ``latency_book`` and ``books_lock`` are shared with the
+    other replicas (and the report reader) — everything else is private.
+    """
+
+    def __init__(
+        self,
+        sid: int,
+        model,
+        params,
+        mesh,
+        ladder: BucketLadder,
+        queue: RequestQueue,
+        recorder: SpanRecorder,
+        latency_book: LatencyBook,
+        batch_stats=None,
+        books_lock: threading.Lock | None = None,
+        max_wait_ms: float = 5.0,
+        num_classes: int | None = None,
+        on_retrace: str = "raise",
+        fault: str = "",
+        fault_rank: int | None = None,
+        hb=None,
+        router=None,
+        model_version: int = 1,
+        peak_flops: float | None = None,
+        bucket_flops: dict[int, float] | None = None,
+        registry: Counters | None = None,
+    ):
+        import jax
+
+        from tpu_dp.parallel import dist
+        from tpu_dp.parallel.sharding import (
+            batch_sharding, replicated_sharding,
+        )
+        from tpu_dp.train.state import TrainState
+
+        self.sid = int(sid)
+        self.model = model
+        self.mesh = mesh
+        self.ladder = ladder
+        self.queue = queue
+        self.recorder = recorder
+        self.latency_book = latency_book
+        self.batcher = DynamicBatcher(queue, ladder, max_wait_ms=max_wait_ms)
+        self.router = router
+        self._counters = _global_counters if registry is None else registry
+        self._on_retrace = on_retrace
+        self._hb = hb
+        self._faults = parse_fault_specs(
+            fault, self.sid if fault_rank is None else int(fault_rank)
+        )
+
+        # Inference state: params (+ BN stats) only, replicated over THIS
+        # replica's mesh, never donated. The empty opt_state is the point —
+        # serving a checkpoint must not pay for (or know about) optimizer
+        # slots, and a post-PR-10 checkpoint's error-feedback residuals
+        # are equally training-only (`checkpoint.load_params_only`).
+        self._repl = replicated_sharding(mesh)
+        state = TrainState(
+            step=np.zeros((), np.int32),
+            params=params,
+            opt_state={},
+            batch_stats=batch_stats or {},
+        )
+        self._state = jax.device_put(state, self._repl)
+        self.model_version = int(model_version)
+        self._pending_state = None  # (device_state, version) hot-swap park
+
+        if num_classes is None:
+            from tpu_dp.train.step import _infer_forward
+
+            probe = np.zeros((1,) + self.queue.image_shape,
+                             self.queue.image_dtype)
+            shapes = jax.eval_shape(
+                lambda s, b: _infer_forward(model, s, b),
+                self._state, {"image": probe},
+            )
+            num_classes = int(shapes[0].shape[-1])
+        self.num_classes = int(num_classes)
+
+        from tpu_dp.train.step import init_serve_stats
+
+        self._stats = jax.device_put(
+            init_serve_stats(self.num_classes), self._repl
+        )
+        self._batch_sharding = {
+            b: (batch_sharding(mesh)
+                if b % dist.data_axis_size(mesh) == 0 else self._repl)
+            for b in ladder.buckets
+        }
+        self._programs: dict[int, object] = {}
+        # Per-bucket per-chip FLOPs snapshot (engine.register_serve_costs):
+        # utilization gauges compute from THIS replica's own numbers, so a
+        # second topology registering the shared `serve_step@bN` cost-
+        # registry keys with a different world cannot corrupt them.
+        self._bucket_flops = dict(bucket_flops or {})
+        self._peak = peak_flops
+        if self._peak is None:
+            try:
+                from tpu_dp.obs import costs as _costs
+
+                self._peak = _costs.peak_flops(
+                    jax.devices()[0].device_kind
+                )
+            except Exception:
+                self._peak = None
+
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._error: BaseException | None = None
+        self._batch_index = 0
+        self._bucket_counts: dict[int, int] = {}
+        # The dispatch lock brackets donated-stats consumption and
+        # reassignment as one atomic step (device_stats/report vs the
+        # dispatch thread); the books lock guards the SHARED recorder +
+        # latency book across replicas. For a single-replica engine both
+        # default to the same object — exactly the old engine locking.
+        self._lock = threading.Lock()
+        self._books_lock = self._lock if books_lock is None else books_lock
+
+        self.status = "idle"  # idle | running | stopped | left | dead
+        self.draining = False
+        self.drain_reason = ""
+        self.quarantined = False
+        self.inflight_since: float | None = None  # monotonic; device-held
+        self.last_progress = time.monotonic()
+
+    # -- programs --------------------------------------------------------
+
+    def _program(self, bucket: int):
+        from tpu_dp.analysis.recompile import RecompileGuard
+        from tpu_dp.train.step import make_serve_step
+
+        prog = self._programs.get(bucket)
+        if prog is None:
+            prog = RecompileGuard(
+                make_serve_step(self.model, self.mesh, bucket),
+                name=f"serve_step@b{bucket}",
+                warmup_calls=1,
+                on_retrace=self._on_retrace,
+            )
+            self._programs[bucket] = prog
+        return prog
+
+    def warmup(self) -> dict[int, float]:
+        """Compile + run every bucket program once; per-bucket wall ms.
+
+        After this, the acceptance bar is ZERO retraces for the rest of
+        the replica's life (`retraces`; the guards raise by default) —
+        including across drain/rejoin cycles, which reuse the compiled
+        programs untouched. Warmup batches are all-padding (weight 0),
+        so the device stats count nothing.
+        """
+        import jax
+
+        times: dict[int, float] = {}
+        for bucket in self.ladder.buckets:
+            t0 = time.perf_counter()
+            # Placed exactly like the live path (`_place_batch`): a warmup
+            # call whose argument signature differs from production calls
+            # would leave the real first request paying the compile.
+            batch = self._place_batch(
+                bucket,
+                np.zeros((bucket,) + self.queue.image_shape,
+                         self.queue.image_dtype),
+                np.zeros((bucket,), np.float32),
+            )
+            self._stats, out = self._program(bucket)(
+                self._stats, self._state, batch
+            )
+            jax.block_until_ready(out)
+            times[bucket] = round((time.perf_counter() - t0) * 1e3, 2)
+        return times
+
+    @property
+    def retraces(self) -> int:
+        """Post-warmup retraces across every bucket program (must stay 0).
+
+        Tolerates non-guard entries: the failover tests (and any chaos
+        harness) replace bucket programs with raising stubs to simulate a
+        dying replica — a dead replica's report must still render."""
+        return sum(
+            getattr(g, "retraces", 0) for g in self._programs.values()
+        )
+
+    def guard_stats(self) -> list[dict]:
+        return [
+            g.stats() for _, g in sorted(self._programs.items())
+            if hasattr(g, "stats")
+        ]
+
+    # -- hot swap --------------------------------------------------------
+
+    def set_pending_state(self, params, batch_stats, version: int) -> None:
+        """Park a new model version; applied between batches (never mid-
+        batch). ``params``/``batch_stats`` may be host arrays — placement
+        onto this replica's mesh happens here, off the dispatch thread."""
+        import jax
+
+        from tpu_dp.train.state import TrainState
+
+        state = jax.device_put(
+            TrainState(
+                step=np.zeros((), np.int32),
+                params=params,
+                opt_state={},
+                batch_stats=batch_stats or {},
+            ),
+            self._repl,
+        )
+        with self._lock:
+            self._pending_state = (state, int(version))
+
+    def _apply_pending_swap(self) -> None:
+        """Dispatch-thread only: swap in a parked version between batches."""
+        with self._lock:
+            pending, self._pending_state = self._pending_state, None
+            if pending is None:
+                return
+            self._state, self.model_version = pending
+        from tpu_dp.obs import flightrec as _flightrec
+
+        self._counters.gauge("serve.model_version", self.model_version)
+        _flightrec.record(
+            "model_swap", replica=self.sid, version=self.model_version,
+            step=self._batch_index,
+        )
+
+    # -- health facts ----------------------------------------------------
+
+    def inflight_age(self, now: float | None = None) -> float | None:
+        """Seconds the current batch has been held on device, or None."""
+        since = self.inflight_since
+        if since is None:
+            return None
+        return (time.monotonic() if now is None else now) - since
+
+    def _touch(self) -> None:
+        self.last_progress = time.monotonic()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "ServeReplica":
+        """Launch (or relaunch — rejoin) the dispatch thread.
+
+        Rejoin is deliberately a plain `start`: programs, state and stats
+        survive a drain, so a returning replica serves its first batch
+        without a restart, a recompile, or a weight reload.
+        """
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(f"replica {self.sid} already running")
+        self._stop.clear()
+        self.draining = False
+        self.drain_reason = ""
+        self.status = "running"
+        self._touch()
+        self._thread = threading.Thread(
+            target=self._loop,
+            name=f"tpu_dp-serve-replica-{self.sid}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop_now(self) -> None:
+        """Abandon mode: exit after at most the in-flight batch."""
+        self._stop.set()
+
+    def request_drain(self, reason: str) -> None:
+        """Stop pulling new batches; finish the in-flight one; leave."""
+        self.drain_reason = reason
+        self.draining = True
+
+    def join(self, timeout: float | None = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if not self._thread.is_alive():
+                self._thread = None
+
+    def take_error(self) -> BaseException | None:
+        err, self._error = self._error, None
+        return err
+
+    # -- the dispatch loop ----------------------------------------------
+
+    def _poll_loop_faults(self) -> None:
+        """Fire loop-scoped fault plans (leave/preempt/kill) at batch
+        boundaries; a fired ``leave`` becomes a drain request — the
+        signal-free SIGTERM twin, per replica."""
+        for inj in self._faults:
+            if inj.plan.kind in _LOOP_FAULT_KINDS:
+                inj.on_step(self._batch_index)
+            if inj.leave_requested and not self.draining:
+                inj.leave_requested = False
+                if self.router is not None:
+                    self.router.begin_drain(
+                        self.sid, reason="preempted (leave)"
+                    )
+                else:
+                    # Single-replica engine: nobody absorbs the queue, so
+                    # a leave means "stop admitting, serve out the queue,
+                    # exit" — close + drain, never abandoned callers.
+                    self.queue.close()
+
+    def _loop(self) -> None:
+        batch = None
+        try:
+            while True:
+                if self._stop.is_set():  # abandon mode: stop(drain=False)
+                    self.status = "stopped"
+                    return
+                self._touch()
+                self._poll_loop_faults()
+                if self.draining:
+                    # Drain-then-leave: the in-flight batch (if any) was
+                    # finished by the previous iteration; new work goes to
+                    # the survivors. The departure epoch is published
+                    # BEFORE status flips to "left" — a rejoiner polling
+                    # the status must find the departure already on the
+                    # ledger, never rejoin-before-depart.
+                    if self.router is not None:
+                        self.router.on_replica_drained(
+                            self.sid, self.drain_reason
+                        )
+                    self.status = "left"
+                    return
+                if self.router is not None and \
+                        not self.router.may_dispatch(self.sid):
+                    if self.queue.closed and len(self.queue) == 0:
+                        # Quarantined through the shutdown drain: nothing
+                        # left to be fed anyway — exit, don't wedge join().
+                        self.status = "stopped"
+                        return
+                    time.sleep(0.02)
+                    continue
+                batch = self.batcher.next_batch(timeout_s=0.05)
+                if batch == "closed":
+                    self.status = "stopped"
+                    return
+                if batch == "timeout":
+                    batch = None
+                    continue
+                if self._stop.is_set():
+                    # Abandon a batch formed while stopping — its popped
+                    # requests go back through the shed-on-close path.
+                    for req in batch.requests:
+                        shed_counted(self._counters, req.handle, SHED_CLOSED)
+                    self.status = "stopped"
+                    return
+                self._apply_pending_swap()
+                self._run_batch(batch)
+                batch = None
+        except BaseException as e:
+            self._error = e
+            self.status = "dead"
+            pending = [
+                r for r in (batch.requests
+                            if isinstance(batch, FormedBatch) else [])
+                if not r.handle.done()
+            ]
+            if self.router is not None:
+                # Failover: the router retries the in-flight batch on a
+                # survivor or sheds it `replica_failed` — typed either way.
+                self.router.on_replica_error(self.sid, e, pending)
+            else:
+                # Single-replica engine (surfaced by stop()): neither the
+                # in-flight batch nor anything queued may wait forever on
+                # a dead loop.
+                self.queue.close()
+                reqs, _ = self.queue.collect(self.ladder.max_batch * 10**6)
+                for req in pending + reqs:
+                    shed_counted(self._counters, req.handle, "engine_error")
+
+    def _place_batch(self, bucket: int, images: np.ndarray,
+                     weight: np.ndarray):
+        """Host batch → device, under the bucket's sharding (one path for
+        warmup and live dispatch, so their jit signatures cannot differ)."""
+        import jax
+
+        sh = self._batch_sharding[bucket]
+        return jax.device_put(
+            {"image": images, "weight": weight},
+            {"image": sh, "weight": sh},
+        )
+
+    def _run_batch(self, batch: FormedBatch) -> None:
+        # Expired handles were resolved (shed) by the queue; nothing to
+        # serve in an all-expired wake.
+        if not batch.requests:
+            return
+        self.inflight_since = time.monotonic()
+        try:
+            self._run_batch_inner(batch)
+        finally:
+            self.inflight_since = None
+            self._touch()
+
+    def _run_batch_inner(self, batch: FormedBatch) -> None:
+        import jax
+
+        t0 = time.perf_counter()
+        dev_batch = self._place_batch(batch.bucket, batch.images,
+                                      batch.weight)
+        jax.block_until_ready(dev_batch)
+        t1 = time.perf_counter()
+        version = self.model_version
+        with self._lock:
+            # The donated stats buffer is consumed by the call below, so
+            # report()/device_stats() must never read `self._stats` while
+            # a dispatch is in flight — the lock brackets consumption and
+            # reassignment as one atomic step.
+            for inj in self._faults:
+                if inj.plan.kind in _DEVICE_FAULT_KINDS:
+                    # Deterministic straggler injection, bracketed inside
+                    # the device span so an injected delay is attributed
+                    # exactly like a real slow device (tests/test_serve.py)
+                    # — and surfaces in this replica's heartbeat, which is
+                    # what the router's staleness quarantine keys off.
+                    inj.on_step(self._batch_index)
+            self._stats, out = self._program(batch.bucket)(
+                self._stats, self._state, dev_batch
+            )
+            jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        predictions = np.asarray(out["prediction"])
+        confidence = np.asarray(out["confidence"])
+        t3 = time.perf_counter()
+
+        h2d_ms = (t1 - t0) * 1e3
+        device_ms = (t2 - t1) * 1e3
+        d2h_ms = (t3 - t2) * 1e3
+        with self._lock:
+            self._bucket_counts[batch.bucket] = (
+                self._bucket_counts.get(batch.bucket, 0) + 1
+            )
+            self._batch_index += 1
+        # Per-device HBM gauges from the dispatch loop — serving was the
+        # one workload flying blind on device memory (the trainer already
+        # publishes these per window). Backends without memory stats
+        # publish nothing.
+        from tpu_dp.obs.counters import update_device_memory_gauges
+
+        update_device_memory_gauges(registry=self._counters)
+        # Per-bucket device utilization — the fraction of the chip's peak
+        # this dispatch's forward used, from the same analytic per-chip
+        # FLOPs `register_serve_costs` published to the cost registry.
+        from tpu_dp.obs import flightrec as _flightrec
+
+        flops = self._bucket_flops.get(batch.bucket)
+        util = (
+            flops / (device_ms / 1e3) / self._peak
+            if flops and self._peak and device_ms > 0 else None
+        )
+        if util is not None:
+            self._counters.gauge(f"serve.device_util.b{batch.bucket}",
+                                 round(util, 4))
+            self._counters.gauge("serve.device_util", round(util, 4))
+        # The heartbeat write (file I/O — the realistic raiser in this
+        # tail) happens BEFORE any handle is claimed: an exception here
+        # leaves every handle unclaimed, so the normal failover/shed path
+        # still accounts for all of them.
+        if self._hb is not None:
+            self._hb.beat(
+                step=self._batch_index,
+                step_ms=batch.form_ms + (t3 - t0) * 1e3,
+            )
+        resolutions = []
+        missed_by_class: dict[int, int] = {}
+        completed_by_class: dict[int, int] = {}
+        try:
+            with self._books_lock:
+                for req, sl in zip(batch.requests, batch.slices):
+                    if not req.handle._claim():
+                        continue  # lost a failover race; books untouched
+                    latency_ms = (t3 - req.arrival) * 1e3
+                    deadline_missed = t3 > req.deadline
+                    cls = req.slo_class
+                    completed_by_class[cls] = \
+                        completed_by_class.get(cls, 0) + 1
+                    if deadline_missed:
+                        missed_by_class[cls] = \
+                            missed_by_class.get(cls, 0) + 1
+                    spans = {
+                        "queue_wait": max(
+                            0.0,
+                            (batch.formed - req.arrival) * 1e3
+                            - batch.form_ms,
+                        ),
+                        "batch_form": batch.form_ms,
+                        "h2d": h2d_ms,
+                        "device": device_ms,
+                        "d2h": d2h_ms,
+                        "total": latency_ms,
+                    }
+                    self.recorder.record(req.req_id, spans,
+                                         ts=req.arrival_ts)
+                    self.latency_book.note(cls, latency_ms)
+                    resolutions.append(
+                        (req, sl, latency_ms, deadline_missed, spans)
+                    )
+            # Publish counters BEFORE waking any waiter: a caller whose
+            # last handle just resolved must read books that already
+            # include it (the loadgen's exact-consistency audit depends
+            # on this order).
+            completed = sum(completed_by_class.values())
+            missed = sum(missed_by_class.values())
+            self._counters.inc("serve.batches")
+            self._counters.inc("serve.completed", completed)
+            for cls, n in sorted(completed_by_class.items()):
+                self._counters.inc(f"serve.completed.c{cls}", n)
+            if missed:
+                self._counters.inc("serve.deadline_missed", missed)
+                for cls, n in sorted(missed_by_class.items()):
+                    self._counters.inc(f"serve.deadline_missed.c{cls}", n)
+            self._counters.gauge("serve.batch_occupancy", batch.occupancy)
+            self._counters.inc(f"serve.replica_batches.{self.sid}")
+            _flightrec.record(
+                "serve_dispatch", bucket=batch.bucket, replica=self.sid,
+                n=len(resolutions), occupancy=batch.occupancy,
+                device_ms=round(device_ms, 3), deadline_missed=missed,
+                version=version,
+            )
+            for req, sl, latency_ms, deadline_missed, spans in resolutions:
+                req.handle.model_version = version
+                req.handle.served_by = self.sid
+                req.handle._finish_resolve(
+                    predictions[sl].copy(), confidence[sl].copy(),
+                    latency_ms, deadline_missed, spans,
+                )
+        except BaseException:
+            # A claimed handle is invisible to every other resolver (the
+            # claim guard no-ops them), so whatever just raised, the
+            # already-claimed handles MUST still be finished here — their
+            # results exist — or their callers would block forever.
+            for req, sl, latency_ms, deadline_missed, spans in resolutions:
+                if not req.handle.done():
+                    req.handle.model_version = version
+                    req.handle.served_by = self.sid
+                    req.handle._finish_resolve(
+                        predictions[sl].copy(), confidence[sl].copy(),
+                        latency_ms, deadline_missed, spans,
+                    )
+            raise
+
+    # -- reporting -------------------------------------------------------
+
+    def device_stats(self) -> dict:
+        """The donated stats pytree, fetched: device-side ground truth.
+
+        A replica that died mid-execution may hold a consumed (donated)
+        buffer — that is reported honestly as unreadable rather than as a
+        fake zero, and the cluster sum marks itself accordingly.
+        """
+        try:
+            with self._lock:
+                served = np.asarray(self._stats["served"])
+                counts = np.asarray(self._stats["class_counts"])
+            return {
+                "served": int(served),
+                "class_counts": [int(c) for c in counts],
+            }
+        except Exception:
+            return {"served": 0, "class_counts": [], "unreadable": True}
+
+    def snapshot(self) -> dict:
+        """Host-side replica facts for the cluster report."""
+        with self._lock:
+            return {
+                "status": self.status,
+                "batches": self._batch_index,
+                "bucket_counts": dict(sorted(self._bucket_counts.items())),
+                "quarantined": self.quarantined,
+                "model_version": self.model_version,
+                "retraces": self.retraces,
+                "devices": int(self.mesh.devices.size),
+            }
