@@ -174,6 +174,74 @@ def test_resplit_rejects_bad_lineage():
         elastic_resplit(16, True, 0, 0, 4, [], 2, 5)
 
 
+def test_resplit_grow_exact_coverage_shrink_then_grow():
+    """The grow half of the re-split contract (ISSUE 12): 3 ranks run 2
+    steps, the mesh shrinks to 2 for 1 step, then GROWS back to 3 — the
+    union of everything consumed plus the grown tails is exactly the
+    epoch, and every member of the grown world gets the identical step
+    count."""
+    E, B = 96, 4
+    consumed3 = _consumed(E, 3, 2, B)                      # world 3, 2 steps
+    seg2 = [elastic_resplit(E, True, 7, 0, B, [(3, 2)], 2, m)[:B]
+            for m in range(2)]                             # world 2, 1 step
+    tails = [elastic_resplit(E, True, 7, 0, B, [(3, 2), (2, 1)], 3, m)
+             for m in range(3)]                            # grown back to 3
+    everything = np.concatenate([consumed3, *seg2, *tails])
+    # E = 96 is divisible by every world in the lineage: exactness is
+    # total up to the min-shard truncation seam.
+    joined = sorted(everything.tolist())
+    assert len(joined) == len(set(joined)), "a sample consumed twice"
+    shed = E - len(joined)
+    assert shed < 3 * B, f"{shed} samples shed beyond one global batch"
+    # Lockstep on the grown world: identical whole-step counts.
+    assert len({len(t) for t in tails}) == 1
+    assert len(tails[0]) % B == 0 and len(tails[0]) > 0
+
+
+def test_resplit_grow_lockstep_on_awkward_remainders():
+    """Grow hops with non-divisible sizes, including grow→grow and
+    shrink→grow lineages: the re-split must still hand every member of
+    the larger world the same whole-step count, consume nothing twice,
+    and invent nothing (satellite: grow-segment unit oracle)."""
+    from collections import Counter
+
+    cases = [
+        # (E, lineage, new_world, B)
+        (50, [(2, 2)], 3, 4),             # plain grow 2→3
+        (47, [(3, 1), (2, 2)], 3, 4),     # shrink 3→2 then grow 2→3
+        (49, [(1, 3)], 4, 2),             # world 1 grows to 4
+        (53, [(2, 1), (3, 2)], 5, 2),     # grow→grow
+    ]
+    for E, lineage, new_world, B in cases:
+        tails = [elastic_resplit(E, True, 11, 2, B, lineage, new_world, m)
+                 for m in range(new_world)]
+        assert len({len(t) for t in tails}) == 1, (E, lineage, new_world)
+        assert len(tails[0]) % B == 0
+        # Nothing is invented: per-sample consumption (replayed lineage +
+        # grown tails) never exceeds the padded stream's plan.
+        base = ShardedSampler(E, 1, 0, shuffle=True, seed=11)
+        base.set_epoch(2)
+        stream_counts: Counter = Counter()
+        remaining = base.shard_indices()
+        consumed_all: list[np.ndarray] = []
+        from tpu_dp.data.sampler import _pad_to_multiple
+
+        for world, steps in lineage:
+            stream = _pad_to_multiple(remaining, world)
+            stream_counts.update(stream.tolist())
+            shards = [stream[r::world] for r in range(world)]
+            consumed_all += [s[: steps * B] for s in shards]
+            remaining = np.concatenate([s[steps * B:] for s in shards])
+        stream_counts.update(
+            _pad_to_multiple(remaining, new_world).tolist()
+        )
+        got = Counter(np.concatenate(consumed_all + tails).tolist())
+        # (the padded-stream multiset only ever grows, so this bounds
+        # every hop's wraparound duplicates)
+        for sample, n in got.items():
+            assert n <= stream_counts[sample], (E, lineage, sample)
+
+
 # ---------------------------------------------------------------------------
 # MembershipLedger: the file protocol, exercised by real threads
 # ---------------------------------------------------------------------------
@@ -302,6 +370,338 @@ def test_quiesce_ack_barrier(tmp_path):
     assert led0.await_quiesced(1, [0, 1], timeout_s=0.3) == [1]  # 1 missing
     led1.ack_quiesced(1)
     assert led0.await_quiesced(1, [0, 1], timeout_s=2) == []
+
+
+# ---------------------------------------------------------------------------
+# grow: join requests, fencing, grow plans (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+def test_join_claim_is_exclusive_per_transition(tmp_path):
+    led = MembershipLedger(tmp_path, 2)
+    assert led.publish_join(1, 2, token="aaa", generation=tmp_path.name)
+    # A second incarnation racing for the same seat loses the claim and
+    # can read whose token holds it.
+    assert not led.publish_join(1, 2, token="bbb", generation=tmp_path.name)
+    assert led.join_request(1, 2)["token"] == "aaa"
+
+
+def test_zombie_from_retired_generation_is_refused(tmp_path):
+    """The fencing acceptance (ISSUE 12): a zombie whose worldview is a
+    RETIRED generation — its join request names the old generation dir —
+    must be refused admission with a typed verdict, never admitted."""
+    led = MembershipLedger(tmp_path / "gen_live", 0)
+    led.write_initial([0, 1], None)
+    # The zombie constructed its request from the stale incarnation's
+    # view: it names gen_retired while publishing into the live dir.
+    zled = MembershipLedger(tmp_path / "gen_live", 7)
+    zled.publish_join(1, 7, token="zzz", generation="gen_retired")
+    accepted = led.validate_joins(1, [0, 1])
+    assert accepted == {}
+    refusal = led.join_refusal(1, 7)
+    assert refusal is not None
+    assert "stale generation" in refusal["reason"]
+    # The verdict is final for the transition: even if the zombie's view
+    # somehow became right, this epoch never admits it.
+    assert led.validate_joins(1, [0, 1]) == {}
+    # ... and a refused request never triggers a grow plan.
+    led.check_in(1, 5, leaving=False, flavor="graceful")
+    led.maybe_publish_plan(1, [0, 1], train_epoch=0, timed_out=True)
+    plan = led.try_plan(1)
+    assert plan.flavor == "rollback"  # member 1 timed out, not a grow
+    assert plan.joiners == ()
+
+
+def test_zombie_targeting_retired_epoch_is_refused(tmp_path):
+    """The fencing a REAL zombie trips: it built its request from a
+    retired record, so it targets a transition the live run is past —
+    refused with a typed verdict by the members' hygiene sweep. A claim
+    at exactly the current epoch (the shrink-deferred case, whose owner
+    is re-targeting) is deliberately spared."""
+    led = MembershipLedger(tmp_path, 0)
+    led.write_initial([0, 1], None)
+    # Epoch 2 ADMITTED sid 9 — its (consumed) join file must never be
+    # retro-refused, or every successful grow would leave a phantom
+    # "zombie" verdict in the forensic record.
+    led.publish_epoch(MembershipRecord(
+        epoch=2, members=(0, 1, 9), coordinator=None,
+        joined=({"sid": 9, "token": "ok"},), ts=time.time()))
+    MembershipLedger(tmp_path, 9).publish_join(
+        2, 9, token="ok", generation=tmp_path.name)
+    led.publish_epoch(MembershipRecord(
+        epoch=3, members=(0, 1, 9), coordinator=None, ts=time.time()))
+    zombie = MembershipLedger(tmp_path, 7)
+    zombie.publish_join(1, 7, token="old", generation=tmp_path.name)
+    deferred = MembershipLedger(tmp_path, 8)
+    deferred.publish_join(3, 8, token="cur", generation=tmp_path.name)
+    # sid 5's e1 request was deferred (shrink won) and it was admitted
+    # only at a LATER epoch: its stale first file must be spared because
+    # it is a current member now.
+    MembershipLedger(tmp_path, 5).publish_join(
+        1, 5, token="def", generation=tmp_path.name)
+    led.refuse_stale_joins(current_epoch=3, members=[0, 1, 5, 9])
+    refusal = led.join_refusal(1, 7)
+    assert refusal is not None and "stale epoch" in refusal["reason"]
+    assert led.join_refusal(3, 8) is None  # current-epoch claim spared
+    assert led.join_refusal(2, 9) is None  # admitted claim spared
+    assert led.join_refusal(1, 5) is None  # deferred-then-admitted spared
+
+
+def test_join_refused_when_sid_is_live_member(tmp_path):
+    led = MembershipLedger(tmp_path, 0)
+    led.write_initial([0, 1], None)
+    led.publish_join(1, 1, token="ttt", generation=tmp_path.name)
+    assert led.validate_joins(1, [0, 1]) == {}
+    assert "live member" in led.join_refusal(1, 1)["reason"]
+
+
+def test_join_refused_beyond_max_world(tmp_path):
+    led = MembershipLedger(tmp_path, 0)
+    led.write_initial([0, 1], None)
+    led.publish_join(1, 2, token="t2", generation=tmp_path.name)
+    led.publish_join(1, 3, token="t3", generation=tmp_path.name)
+    accepted = led.validate_joins(1, [0, 1], max_world=3)
+    # Deterministic lowest-sid-first admission under the bound.
+    assert sorted(accepted) == [2]
+    assert "elastic_max_world" in led.join_refusal(1, 3)["reason"]
+
+
+def test_grow_plan_from_valid_join(tmp_path):
+    led = MembershipLedger(tmp_path, 0)
+    led.write_initial([0, 1], None)
+    joiner = MembershipLedger(tmp_path, 2)
+    joiner.publish_join(1, 2, token="tok", generation=tmp_path.name)
+    for sid in (0, 1):
+        MembershipLedger(tmp_path, sid).check_in(
+            1, 6 + sid, leaving=False, flavor="graceful")
+    led.maybe_publish_plan(1, [0, 1], train_epoch=0, timed_out=False)
+    plan = led.try_plan(1)
+    assert plan is not None and plan.flavor == "grow"
+    assert plan.joiners == (2,)
+    assert plan.survivors == (0, 1, 2)
+    assert plan.incumbents == (0, 1)
+    assert plan.leavers == () and plan.departed == ()
+    # Stop threshold clears every *member's* published position (the
+    # joiner is not stepping and publishes none).
+    assert plan.stop_step > 7
+
+
+def test_shrink_wins_over_concurrent_join(tmp_path):
+    """The join-during-shrink race has an explicit answer: a transition
+    with a leaver resolves the shrink alone; the pending join is deferred
+    (the joiner re-targets the next epoch)."""
+    led = MembershipLedger(tmp_path, 0)
+    led.write_initial([0, 1, 2], None)
+    joiner = MembershipLedger(tmp_path, 5)
+    joiner.publish_join(1, 5, token="tok", generation=tmp_path.name)
+    for sid, leaving in ((0, False), (1, False), (2, True)):
+        MembershipLedger(tmp_path, sid).check_in(
+            1, 4, leaving=leaving, flavor="graceful")
+    led.maybe_publish_plan(1, [0, 1, 2], train_epoch=0, timed_out=False)
+    plan = led.try_plan(1)
+    assert plan.flavor == "graceful"
+    assert plan.leavers == (2,)
+    assert plan.joiners == () and 5 not in plan.survivors
+    # No refusal either: the claim simply rides to the next transition.
+    assert led.join_refusal(1, 5) is None
+
+
+def test_request_join_admission_handshake_threads(tmp_path):
+    """The joiner's client half against a live member thread: request →
+    grow plan → epoch record echoing the token → admitted."""
+    from tpu_dp.resilience.elastic import request_join
+
+    gen = tmp_path / "gen_x"
+    led = MembershipLedger(gen, 0)
+    led.write_initial([0], None)
+
+    def member():
+        # A world-1 member converging a grow transition the way the
+        # trainer does: poll, check in, publish, establish.
+        deadline = time.monotonic() + 20
+        step = 3
+        while time.monotonic() < deadline:
+            joins = led.validate_joins(1, [0])
+            if joins:
+                break
+            time.sleep(0.01)
+        while time.monotonic() < deadline:
+            led.check_in(1, step, leaving=False, flavor="graceful")
+            led.maybe_publish_plan(1, [0], train_epoch=0, timed_out=False)
+            plan = led.try_plan(1)
+            if plan is not None:
+                break
+            step += 1
+            time.sleep(0.01)
+        req = led.join_request(1, 2)
+        rec = MembershipRecord(
+            epoch=1, members=tuple(sorted(plan.survivors)),
+            coordinator="127.0.0.1:1",
+            joined=({"sid": 2, "token": req["token"]},),
+            service_sid=0, resume={"epoch": 0, "steps_done": plan.stop_step,
+                                   "lineage": [], "global_step":
+                                   plan.stop_step, "snapshot_dir": None},
+            reason="grow", ts=time.time(),
+        )
+        led.publish_epoch(rec)
+
+    t = threading.Thread(target=member)
+    t.start()
+    record, token = request_join(gen, 2, timeout_s=15)
+    t.join(timeout=20)
+    assert record.epoch == 1 and record.members == (0, 2)
+    assert record.joined == ({"sid": 2, "token": token},)
+    assert record.service_sid == 0
+    assert record.rank_of(2) == 1
+
+
+def test_join_ready_gate(tmp_path):
+    """The incumbents' commit gate: a grown bootstrap starts only once
+    every admitted joiner signalled ready (a coordination connect with an
+    absent party is a LOG(FATAL), not a catchable error)."""
+    led = MembershipLedger(tmp_path, 0)
+    assert led.await_join_ready(2, [5], timeout_s=0.2) == [5]  # ghost
+    MembershipLedger(tmp_path, 5).confirm_join_ready(2, 5)
+    assert led.await_join_ready(2, [5], timeout_s=2) == []
+
+
+def test_request_join_refusal_is_typed(tmp_path):
+    from tpu_dp.resilience.elastic import request_join
+
+    gen = tmp_path / "gen_y"
+    led = MembershipLedger(gen, 0)
+    led.write_initial([0], None)
+
+    def refuser():
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if led.join_request(1, 3) is not None:
+                led.refuse_join(1, 3, "world at resilience.elastic_max_world=1")
+                return
+            time.sleep(0.01)
+
+    t = threading.Thread(target=refuser)
+    t.start()
+    with pytest.raises(ElasticError, match="join refused.*max_world"):
+        request_join(gen, 3, timeout_s=10)
+    t.join(timeout=15)
+
+
+def test_request_join_times_out_on_dead_generation(tmp_path):
+    from tpu_dp.resilience.elastic import request_join
+
+    gen = tmp_path / "gen_dead"
+    MembershipLedger(gen, 0).write_initial([0, 1], None)
+    with pytest.raises(ElasticError, match="no admission"):
+        request_join(gen, 2, timeout_s=0.5, attempts=1)
+
+
+def test_find_live_generation_picks_newest_by_record_ts(tmp_path):
+    from tpu_dp.resilience.elastic import find_live_generation
+
+    assert find_live_generation(tmp_path / "nope") is None
+    old = MembershipLedger(tmp_path / "gen_old", 0)
+    old.publish_epoch(MembershipRecord(
+        epoch=0, members=(0, 1, 2), coordinator=None, ts=100.0))
+    new = MembershipLedger(tmp_path / "gen_new", 0)
+    new.publish_epoch(MembershipRecord(
+        epoch=0, members=(0, 1, 2), coordinator=None, ts=200.0))
+    new.publish_epoch(MembershipRecord(
+        epoch=1, members=(0, 1), coordinator=None, ts=300.0,
+        departed=({"sid": 2, "reason": "preempted (graceful)"},)))
+    gen_dir, rec = find_live_generation(tmp_path)
+    assert gen_dir.name == "gen_new"
+    assert rec.epoch == 1 and rec.members == (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# ledger filesystem IO: bounded, jittered retry (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_io_retries_transient_errors(tmp_path, monkeypatch):
+    """A transient shared-FS error is a retry, not a spurious failure:
+    the first two os.replace calls blow up with EIO, the third lands —
+    and the attempts are published to the retry.* obs counters."""
+    import tpu_dp.resilience.elastic as elastic_mod
+    from tpu_dp.obs.counters import counters
+
+    monkeypatch.setattr(elastic_mod, "_IO_BASE_DELAY_S", 0.001)
+    fails = {"n": 2}
+    real_replace = os.replace
+
+    def flaky_replace(src, dst):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError(5, "Input/output error (injected)")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", flaky_replace)
+    before = counters.get("retry.retries")
+    led = MembershipLedger(tmp_path, 0)
+    led.check_in(1, 7, leaving=False, flavor="graceful")
+    assert led.check_ins(1)[0]["step"] == 7  # the write ultimately landed
+    assert counters.get("retry.retries") - before >= 2
+
+
+def test_ledger_io_exhaustion_raises_typed_error(tmp_path, monkeypatch):
+    import tpu_dp.resilience.elastic as elastic_mod
+    from tpu_dp.obs.counters import counters
+
+    monkeypatch.setattr(elastic_mod, "_IO_BASE_DELAY_S", 0.001)
+
+    def always_fails(src, dst):
+        raise OSError(5, "Input/output error (injected, permanent)")
+
+    monkeypatch.setattr(os, "replace", always_fails)
+    before = counters.get("retry.exhausted")
+    led = MembershipLedger(tmp_path, 0)
+    with pytest.raises(ElasticError, match="failed after .* attempts"):
+        led.check_in(1, 7, leaving=False, flavor="graceful")
+    assert counters.get("retry.exhausted") - before >= 1
+
+
+def test_ledger_read_absent_is_answer_not_error(tmp_path):
+    # FileNotFoundError is protocol state (record not written yet); the
+    # retry layer must pass it through as None immediately.
+    led = MembershipLedger(tmp_path, 0)
+    assert led.try_plan(4) is None
+    assert led.join_request(4, 9) is None
+
+
+def test_ledger_read_exhaustion_degrades_to_none(tmp_path, monkeypatch):
+    """Exhausted READS degrade to "not readable yet" instead of raising:
+    every read sits in a protocol poll loop already bounded by
+    regroup_timeout_s, so the poll cadence out-retries any in-call
+    schedule — a long FS brownout must not kill the rank mid-regroup."""
+    import tpu_dp.resilience.elastic as elastic_mod
+
+    monkeypatch.setattr(elastic_mod, "_IO_BASE_DELAY_S", 0.001)
+    led = MembershipLedger(tmp_path, 0)
+    led.check_in(1, 7, leaving=False, flavor="graceful")
+
+    def always_fails(self, *a, **kw):
+        raise OSError(5, "Input/output error (injected, permanent)")
+
+    monkeypatch.setattr(Path, "read_text", always_fails)
+    assert led.try_plan(1) is None  # degraded, not raised
+
+
+def test_faultinject_relaunch_departs_like_leave():
+    from tpu_dp.resilience import FaultInjector, FaultPlan
+
+    plan = FaultPlan.parse("relaunch:step=3,rank=1")
+    assert (plan.kind, plan.step, plan.rank) == ("relaunch", 3, 1)
+    bystander = FaultInjector(plan, rank=0)
+    bystander.on_step(9)
+    assert not bystander.leave_requested
+    target = FaultInjector(plan, rank=1)
+    target.on_step(2)
+    assert not target.leave_requested
+    target.on_step(3)
+    # Departs exactly like leave:; `run_elastic` keys the rejoin off the
+    # fired plan's kind.
+    assert target.leave_requested and target.fired
 
 
 # ---------------------------------------------------------------------------

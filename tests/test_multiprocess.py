@@ -553,9 +553,12 @@ if guard:
     cfg.guard.spike_min_steps = 64
     cfg.resilience.snapshot_every_steps = 1
 
-tr = Trainer(cfg)
+from tpu_dp.train.trainer import run_elastic
 try:
-    result = tr.fit()
+    # run_elastic == Trainer(cfg).fit() everywhere except a fired
+    # `relaunch:` fault, which rejoins the run in-process (the
+    # deterministic twin of "the preempted rank comes back").
+    tr, result = run_elastic(cfg)
 except PreemptedError as e:
     print("ELASTIC_LEFT", rank, repr(str(e)), flush=True)
     sys.exit(143)
@@ -762,9 +765,10 @@ def test_three_process_elastic_preempt_rank2(tmp_path):
     assert record["reason"] == "graceful"
     # DP304 re-verification ran on the shrunk mesh before the first
     # post-regroup step (logged by the new rank 0; the check itself is an
-    # allgather-compare on every rank).
+    # allgather-compare on every rank). The tag is keyed by membership
+    # epoch AND world size (ISSUE 12 satellite).
     new_rank0 = next(s for s in results if results[s]["new_rank"] == 0)
-    assert ("collective-schedule fingerprint (train_step@me1)"
+    assert ("collective-schedule fingerprint (train_step@me1w2)"
             in logs[new_rank0])
 
 
@@ -901,6 +905,353 @@ def test_three_process_sdc_audit_names_rank2_and_regroups(tmp_path):
     steps = [e["step"] for e in events if e["kind"] == "step"]
     assert steps and len(steps) == len(set(steps))
     assert out["stats"]["steps"]["replayed_beats_deduped"] > 0
+
+
+def _read_ledger_records(ckpt_dir: Path) -> list[dict]:
+    """All membership-epoch records of the run's (single) generation."""
+    gens = sorted((ckpt_dir / "membership").iterdir())
+    assert len(gens) == 1, gens
+    return [json.loads(p.read_text())
+            for p in sorted(gens[0].glob("epoch_*.json"))]
+
+
+def _elastic_ledger_oracle_params(records, *, num_examples, batch=4,
+                                  epochs=2, seed=0, sampler_seed=0):
+    """Single-device oracle over an ARBITRARY graceful/grow transition
+    history, reconstructed from the membership ledger alone.
+
+    Generalizes `_elastic_oracle_params` (one shrink) to any sequence of
+    graceful shrinks and grows: for each dataset epoch, the newest record
+    whose resume targets it supplies the full consumption lineage (each
+    prefix is a segment: ``steps_i`` optimizer steps at ``world_i``), the
+    remainder runs re-split at that record's world; epochs no transition
+    touched run wholly at the world current when they started. Rollback
+    flavors rewind the clock and are out of scope here (asserted absent).
+    """
+    import jax
+
+    from tpu_dp.config import Config
+    from tpu_dp.data.cifar import load_dataset
+    from tpu_dp.data.sampler import ShardedSampler, elastic_resplit
+    from tpu_dp.models import Net
+    from tpu_dp.parallel import dist
+    from tpu_dp.train import SGD, create_train_state, make_train_step
+    from tpu_dp.train.schedule import make_schedule
+
+    assert all(r.get("reason") in ("initial", "graceful", "grow")
+               for r in records), [r.get("reason") for r in records]
+    defaults = Config()
+    ds = load_dataset("synthetic", "./data", train=True,
+                      allow_synthetic=True,
+                      synthetic_num_examples=num_examples, seed=seed)
+
+    def segment_streams(epoch, prior, world):
+        if not prior:
+            out = []
+            for r in range(world):
+                s = ShardedSampler(len(ds), world, r, shuffle=True,
+                                   seed=sampler_seed)
+                s.set_epoch(epoch)
+                out.append(s.shard_indices())
+            return out
+        return [elastic_resplit(len(ds), True, sampler_seed, epoch, batch,
+                                prior, world, r) for r in range(world)]
+
+    def segments_for_epoch(e):
+        touching = [r for r in records[1:]
+                    if (r.get("resume") or {}).get("epoch") == e]
+        if touching:
+            last = touching[-1]
+            lineage = [list(map(int, seg))
+                       for seg in last["resume"]["lineage"]]
+            segs = []
+            for i, (world, steps) in enumerate(lineage):
+                segs.append((lineage[:i], world, steps))
+            segs.append((lineage, int(last["world"]), None))
+            return segs
+        # Untouched epoch: the world current when it started = the newest
+        # record whose transition predates it (resume.epoch < e).
+        world = int(records[0]["world"])
+        for r in records[1:]:
+            if (r.get("resume") or {}).get("epoch", 10**9) < e:
+                world = int(r["world"])
+        return [([], world, None)]
+
+    mesh1 = dist.data_mesh(num_devices=1)
+    model, opt = Net(), SGD(defaults.optim.momentum)
+    state = create_train_state(model, jax.random.PRNGKey(seed),
+                               np.zeros((1, 32, 32, 3), np.float32), opt)
+    step = make_train_step(model, opt, mesh1, make_schedule(
+        "constant", defaults.optim.lr, 1, 0, 0.0))
+    consumed_counts = np.zeros(len(ds), np.int64)
+    for epoch in range(epochs):
+        for prior, world, steps in segments_for_epoch(epoch):
+            streams = segment_streams(epoch, prior, world)
+            n = (min(len(s) for s in streams) // batch
+                 if steps is None else steps)
+            for k in range(n):
+                sel = np.concatenate(
+                    [s[k * batch:(k + 1) * batch] for s in streams])
+                consumed_counts[np.asarray(sel)] += 1
+                state, _ = step(state, {"image": ds.images[sel],
+                                        "label": ds.labels[sel]})
+    return state, consumed_counts
+
+
+@pytest.mark.slow
+@pytest.mark.elastic
+def test_three_process_elastic_grow_relaunch_rank2(tmp_path):
+    """The grow acceptance run (ISSUE 12): 3 CPU processes, rank 2
+    departs at step 2 via the ``relaunch:`` fault (the deterministic
+    in-process twin of a preemption), the survivors shrink to world 2 —
+    and then rank 2 COMES BACK: it discovers the live run through the
+    membership ledger, publishes a fenced join request, the members run a
+    grow-flavor quiesce, and the mesh regrows to world 3, resharding real
+    cross-process flat-sharded optimizer state upward. All three ranks
+    finish BOTH epochs, hold bitwise-identical params, and match the
+    single-device oracle of the exact 3→2→3 sample schedule reconstructed
+    from the ledger alone — elasticity as capacity tracking availability,
+    not monotone decay."""
+    import jax
+
+    procs, outs = _run_elastic_workers(
+        tmp_path, "relaunch:step=2,rank=2",
+        update_sharding="sharded", train_size=96)
+    logs = []
+    try:
+        for p in procs:
+            logs.append(p.communicate(timeout=300)[0].decode())
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+        drained = logs + [
+            p.communicate()[0].decode() for p in procs[len(logs):]
+        ]
+        pytest.fail(
+            "grow workers timed out; logs:\n"
+            + "\n--- next rank ---\n".join(t[-4000:] for t in drained)
+        )
+    # EVERY rank exits 0: the departed rank rejoined and completed.
+    for rank, (p, log) in enumerate(zip(procs, logs)):
+        assert p.returncode == 0, (
+            f"rank {rank}: rc {p.returncode}\n{log[-4000:]}"
+        )
+    results = {r: pickle.loads(outs[r].read_bytes()) for r in range(3)}
+
+    # World regrew: every rank reports world 3 at the final epoch.
+    assert [results[r]["world"] for r in range(3)] == [3, 3, 3]
+    final = results[0]["record"]
+    assert final["members"] == [0, 1, 2]
+    assert final["reason"] == "grow"
+    assert [j["sid"] for j in final["joined"]] == [2]
+    # The service stayed pinned to the incumbent leader.
+    assert final["service_sid"] == 0
+
+    # Ledger story: 3 → 2 (graceful departure) → 3 (grow).
+    records = _read_ledger_records(tmp_path / "ck")
+    assert [r["world"] for r in records] == [3, 2, 3]
+    assert records[1]["reason"] == "graceful"
+    assert [d["sid"] for d in records[1]["departed"]] == [2]
+    assert records[2]["reason"] == "grow"
+
+    # Counters: survivors saw both transitions; the rejoiner counts its
+    # departure AND its join (process-global registry spans incarnations).
+    for sid in (0, 1):
+        c = results[sid]["counters"]
+        assert c["elastic.regroups"] == 2
+        assert c["elastic.lost_ranks"] == 1
+        assert c["elastic.joined_ranks"] == 1
+        assert c["elastic.membership_epoch"] == 2
+    c2 = results[2]["counters"]
+    assert c2["elastic.departures"] == 1
+    assert c2["elastic.joins"] == 1
+
+    # All three ranks hold bitwise-identical params (lockstep survived
+    # shrink-reshard AND grow-reshard of the flat-sharded opt state)...
+    for r in (1, 2):
+        for x, y in zip(jax.tree_util.tree_leaves(results[0]["params"]),
+                        jax.tree_util.tree_leaves(results[r]["params"])):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # ... equal to the ledger-reconstructed single-device oracle.
+    oracle_state, counts = _elastic_ledger_oracle_params(
+        records, num_examples=96)
+    for x, y in zip(jax.tree_util.tree_leaves(results[0]["params"]),
+                    jax.tree_util.tree_leaves(oracle_state.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=2e-5)
+    # Exactly-once across the union of shrink AND grow segments: nothing
+    # consumed twice; seam shedding bounded by one global batch per
+    # re-split (two re-splits happened).
+    assert (counts <= 2).all(), "a sample was consumed twice in one epoch"
+    dropped = int((counts < 2).sum())
+    assert dropped < 2 * 3 * 4 * 2, f"{dropped} samples dropped"
+
+    # DP304 re-verified on BOTH re-formed meshes, world-keyed tags.
+    joined_logs = "\n".join(logs)
+    assert "collective-schedule fingerprint (train_step@me1w2)" in joined_logs
+    assert "collective-schedule fingerprint (train_step@me2w3)" in joined_logs
+
+    # The obsctl timeline, from artifacts alone, tells
+    # departure → shrink-regroup → join → grow-regroup → completion.
+    from tpu_dp.obs import obsctl
+
+    out = obsctl.build_timeline(obsctl.RunArtifacts(tmp_path / "ck"))
+    kinds = [e["kind"] for e in out["events"]]
+    story = ["elastic_departure", "elastic_regroup", "rank_joined",
+             "elastic_grow"]
+    positions = [kinds.index(k) for k in story]
+    # The run's FINAL completion comes after the whole round trip (an
+    # intermediate epoch may legitimately complete before the grow lands).
+    positions.append(len(kinds) - 1 - kinds[::-1].index("epoch_complete"))
+    story.append("epoch_complete(last)")
+    assert positions == sorted(positions), (
+        f"story out of order: {list(zip(story, positions))}"
+    )
+    grow_ev = next(e for e in out["events"] if e["kind"] == "elastic_grow")
+    assert grow_ev["detail"]["world"] == 3
+    joined_ev = next(e for e in out["events"] if e["kind"] == "rank_joined")
+    assert joined_ev.get("rank") == 2 or (
+        joined_ev.get("detail", {}).get("sid") == 2)
+
+
+@pytest.mark.slow
+@pytest.mark.elastic
+def test_two_process_joiner_crash_mid_handshake_no_wedge(tmp_path):
+    """A joiner that dies mid-handshake must cost the incumbents only the
+    bounded bootstrap timeout (ISSUE 12 acceptance): 2 processes train,
+    the driver forges a valid join request for sid 2 and never shows up —
+    the members quiesce, publish the grow plan, admit, time out waiting
+    for the ghost at the coordination connect, and RE-FORM at world 2
+    from the very snapshot the grow quiesce committed (no wedge, no
+    rollback, both epochs complete)."""
+    import time
+
+    port = _free_port()
+    outs = [tmp_path / f"jc{rank}.pkl" for rank in range(2)]
+    script = tmp_path / "jc_worker.py"
+    script.write_text(_JOINER_CRASH_WORKER)
+    repo_root = Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        f"{repo_root}{os.pathsep}{env['PYTHONPATH']}"
+        if env.get("PYTHONPATH") else str(repo_root)
+    )
+    env.pop("TPU_DP_FAULT", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(rank), port,
+             str(tmp_path / "ck"), str(outs[rank])],
+            cwd=repo_root, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for rank in range(2)
+    ]
+    # Wait for training to be underway (the delay: fault pins rank 0 at
+    # its step-2 boundary for 3s — a deterministic window), then forge
+    # the ghost joiner's request into the live generation.
+    mem_root = tmp_path / "ck" / "membership"
+    deadline = time.monotonic() + 120
+    gen_dir = None
+    while time.monotonic() < deadline:
+        gens = sorted(mem_root.iterdir()) if mem_root.exists() else []
+        if gens and (gens[0] / "epoch_0000.json").exists():
+            gen_dir = gens[0]
+            break
+        if any(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.05)
+    assert gen_dir is not None, "no membership generation appeared"
+    from tpu_dp.resilience.elastic import MembershipLedger
+
+    ghost = MembershipLedger(gen_dir, 2)
+    assert ghost.publish_join(1, 2, token="ghost-token",
+                              generation=gen_dir.name)
+    logs = []
+    try:
+        for p in procs:
+            logs.append(p.communicate(timeout=300)[0].decode())
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+        drained = logs + [
+            p.communicate()[0].decode() for p in procs[len(logs):]
+        ]
+        pytest.fail(
+            "joiner-crash workers timed out (wedged?); logs:\n"
+            + "\n--- next rank ---\n".join(t[-4000:] for t in drained)
+        )
+    for rank, (p, log) in enumerate(zip(procs, logs)):
+        assert p.returncode == 0, (
+            f"rank {rank}: rc {p.returncode}\n{log[-4000:]}"
+        )
+    results = {r: pickle.loads(outs[r].read_bytes()) for r in range(2)}
+    # The incumbents ended at world 2 — grow attempted, aborted, no loss.
+    assert [results[r]["world"] for r in range(2)] == [2, 2]
+    records = _read_ledger_records(tmp_path / "ck")
+    # epoch 1 admitted the ghost (world 3), epoch 2 is the corrective
+    # re-form at world 2 with the handshake-timeout attribution.
+    assert [r["world"] for r in records] == [2, 3, 2]
+    assert records[1]["reason"] == "grow"
+    assert [j["sid"] for j in records[1]["joined"]] == [2]
+    assert records[2]["reason"] == "grow_aborted"
+    assert records[2]["departed"][0]["sid"] == 2
+    assert "handshake timeout" in records[2]["departed"][0]["reason"]
+    # Same resume payload on both: the aborted grow lost no work.
+    assert records[2]["resume"] == records[1]["resume"]
+    # Params stayed in lockstep through the abort.
+    import jax
+
+    for x, y in zip(jax.tree_util.tree_leaves(results[0]["params"]),
+                    jax.tree_util.tree_leaves(results[1]["params"])):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+_JOINER_CRASH_WORKER = r"""
+import os, pickle, sys
+rank = int(sys.argv[1]); port = sys.argv[2]; ckpt = sys.argv[3]
+out_path = sys.argv[4]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from tpu_dp.config import Config
+from tpu_dp.train.trainer import run_elastic
+
+cfg = Config()
+cfg.data.dataset = "synthetic"
+cfg.data.synthetic_train_size = 64
+cfg.data.synthetic_test_size = 16
+cfg.data.batch_size = 4
+cfg.train.epochs = 2
+cfg.train.log_every = 100
+cfg.train.eval_at_end = False
+cfg.train.steps_per_call = 1
+cfg.train.ckpt_dir = ckpt
+cfg.train.ckpt_async = False
+cfg.train.obs = "basic"
+cfg.resilience.elastic = True
+# Short bound: the ghost joiner never connects; the grow bootstrap must
+# fail within this and fall back to world 2.
+cfg.resilience.regroup_timeout_s = 8
+# One-shot delay pins rank 0 at its step-2 boundary for 3s so the driver
+# can forge the ghost join while training is underway.
+cfg.resilience.fault = "delay:step=2,rank=0,ms=3000"
+cfg.parallel.coordinator_address = f"127.0.0.1:{port}"
+cfg.parallel.num_processes = 2
+cfg.parallel.process_id = rank
+
+tr, result = run_elastic(cfg)
+from tpu_dp.obs.counters import counters
+host_params = jax.tree_util.tree_map(np.asarray, tr.state.params)
+with open(out_path, "wb") as f:
+    pickle.dump(dict(rank=rank, world=tr.ctx.process_count,
+                     record=tr.elastic.record.to_json(),
+                     params=host_params,
+                     counters=counters.snapshot()), f)
+print("JOINER_CRASH_OK", rank, flush=True)
+sys.exit(0)
+"""
 
 
 @pytest.mark.slow

@@ -251,6 +251,34 @@ def test_health_monitor_stale_and_missing(tmp_path):
                for i in exc_info.value.issues)
 
 
+def test_health_monitor_joiner_admission_grace(tmp_path):
+    """A freshly admitted rank (elastic grow) has no heartbeat history
+    and must not be flagged "missing" against the MONITOR's start time —
+    `admit` restarts its grace from the admission moment (ISSUE 12
+    satellite; regression for the joiner-compiles-first-window gap)."""
+    now = time.time()
+    _write_beats(tmp_path, 0, [(8, 10.0, now)])
+    _write_beats(tmp_path, 1, [(8, 10.0, now)])
+    mon = HealthMonitor(tmp_path, world=3, stale_after_s=60.0)
+    mon._start = now - 300.0  # global startup grace long elapsed
+    # Without admission bookkeeping, rank 2 flags missing...
+    assert {(i.kind, i.rank) for i in mon.check(now=now)} == {("missing", 2)}
+    # ...but an admission NOW restarts its personal grace window:
+    mon.admit(2, ts=now - 5.0)
+    assert mon.check(now=now) == []
+    # The grace is per-rank and finite: once the joiner's own grace
+    # elapses with still no beat, it flags again — with the age measured
+    # from ADMISSION, not from the monitor's birth.
+    issues = mon.check(now=now + 100.0)
+    missing = [i for i in issues if i.kind == "missing"]
+    assert [(i.kind, i.rank) for i in missing] == [("missing", 2)]
+    assert missing[0].age_s == pytest.approx(105.0, abs=1.0)
+    # A beat from the admitted rank clears it like any other.
+    _write_beats(tmp_path, 2, [(9, 10.0, now + 100.0)])
+    assert not [i for i in mon.check(now=now + 100.0)
+                if i.kind == "missing"]
+
+
 def test_health_monitor_stale_scales_with_window_duration(tmp_path):
     """Beats arrive once per dispatched window; a window longer than the
     fixed threshold must not mark a healthy, still-beating rank as hung.

@@ -1,0 +1,342 @@
+#!/usr/bin/env python
+"""Elastic GROW smoke: 3 CPU processes, rank 2 SIGTERMed, relaunched,
+rejoined — world 3 → 2 → 3 (the `tools/run_tier1.sh --elastic-grow` lane).
+
+The full production round trip, with a REAL external SIGTERM and a REAL
+relaunch (a fresh OS process, not the in-process `relaunch:` twin):
+
+1. three workers train; a one-shot ``delay:`` fault pins rank 2 at its
+   step-2 boundary so the external SIGTERM lands mid-training
+   deterministically;
+2. rank 2 departs gracefully (exit 143), survivors shrink to world 2;
+3. the relaunched rank 2 — spawned by this driver the way a supervisor
+   would — discovers the live run through the membership ledger
+   (``resilience.elastic_join=always``), publishes a fenced join request,
+   and the members regrow the mesh to world 3;
+4. every process finishes both epochs; verdicts below.
+
+Verdicts (exit 0 clean, 1 on any violation):
+
+- exit codes: old rank 2 exits 143, everyone else (rejoined rank 2
+  included) exits 0 — zero operator action beyond the relaunch;
+- the membership ledger records world 3 → 2 (graceful, rank 2 departed)
+  → 3 (grow, rank 2 joined, token echoed);
+- all three final param digests are identical, and the final params
+  match a single-device oracle replaying the exact 3→2→3 sample
+  schedule reconstructed from the ledger alone (atol 2e-5);
+- ``obsctl timeline`` over NOTHING but the run dir reconstructs
+  departure → shrink-regroup → join → grow-regroup → completion.
+
+Archives ``artifacts/elastic_grow_report.json`` and the timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # the driver imports tpu_dp for the oracle
+    sys.path.insert(0, str(REPO))
+
+_WORKER = r"""
+import os, pickle, sys
+rank = int(sys.argv[1]); port = sys.argv[2]; ckpt = sys.argv[3]
+out_path = sys.argv[4]; join = len(sys.argv) > 5 and sys.argv[5] == "join"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from tpu_dp.config import Config
+from tpu_dp.train.trainer import run_elastic
+from tpu_dp.resilience import PreemptedError
+
+cfg = Config()
+cfg.data.dataset = "synthetic"
+cfg.data.synthetic_train_size = 96
+cfg.data.synthetic_test_size = 16
+cfg.data.batch_size = 4
+cfg.train.epochs = 2
+cfg.train.log_every = 100
+cfg.train.eval_at_end = False
+cfg.train.steps_per_call = 1
+cfg.train.ckpt_dir = ckpt
+cfg.train.ckpt_async = False
+cfg.train.obs = "basic"
+cfg.resilience.elastic = True
+cfg.parallel.coordinator_address = f"127.0.0.1:{port}"
+cfg.parallel.num_processes = 3
+cfg.parallel.process_id = rank
+if join:
+    # The supervisor's relaunch command: join the live run, never
+    # bootstrap (and never trust this incarnation's local view).
+    cfg.resilience.elastic_join = "always"
+else:
+    cfg.resilience.elastic_join = "never"
+    # One-shot delay pins rank 2 at its step-2 boundary for 3s — the
+    # deterministic window for the driver's REAL external SIGTERM.
+    cfg.resilience.fault = "delay:step=2,rank=2,ms=3000"
+
+try:
+    tr, result = run_elastic(cfg)
+except PreemptedError as e:
+    print("GROW_LEFT", rank, repr(str(e)), flush=True)
+    sys.exit(143)
+from tpu_dp.obs.counters import counters
+digest = float(sum(
+    np.abs(np.asarray(l)).sum()
+    for l in jax.tree_util.tree_leaves(tr.state.params)))
+host_params = jax.tree_util.tree_map(np.asarray, tr.state.params)
+with open(out_path, "wb") as f:
+    pickle.dump(dict(rank=rank, world=tr.ctx.process_count,
+                     new_rank=tr.ctx.process_index, digest=digest,
+                     params=host_params,
+                     record=tr.elastic.record.to_json(),
+                     counters=counters.snapshot()), f)
+print("GROW_OK", rank, flush=True)
+sys.exit(0)
+"""
+
+
+def _oracle_params(records: list[dict], num_examples: int, batch: int = 4,
+                   epochs: int = 2, seed: int = 0):
+    """Single-device replay of the ledger's 3→2→3 sample schedule."""
+    import jax
+
+    from tpu_dp.config import Config
+    from tpu_dp.data.cifar import load_dataset
+    from tpu_dp.data.sampler import ShardedSampler, elastic_resplit
+    from tpu_dp.models import Net
+    from tpu_dp.parallel import dist
+    from tpu_dp.train import SGD, create_train_state, make_train_step
+    from tpu_dp.train.schedule import make_schedule
+
+    defaults = Config()
+    ds = load_dataset("synthetic", "./data", train=True,
+                      allow_synthetic=True,
+                      synthetic_num_examples=num_examples, seed=seed)
+
+    def streams(epoch, prior, world):
+        if not prior:
+            out = []
+            for r in range(world):
+                s = ShardedSampler(len(ds), world, r, shuffle=True,
+                                   seed=seed)
+                s.set_epoch(epoch)
+                out.append(s.shard_indices())
+            return out
+        return [elastic_resplit(len(ds), True, seed, epoch, batch, prior,
+                                world, r) for r in range(world)]
+
+    def segments_for_epoch(e):
+        touching = [r for r in records[1:]
+                    if (r.get("resume") or {}).get("epoch") == e]
+        if touching:
+            last = touching[-1]
+            lineage = [list(map(int, seg))
+                       for seg in last["resume"]["lineage"]]
+            segs = [(lineage[:i], int(w), int(s))
+                    for i, (w, s) in enumerate(lineage)]
+            segs.append((lineage, int(last["world"]), None))
+            return segs
+        world = int(records[0]["world"])
+        for r in records[1:]:
+            if (r.get("resume") or {}).get("epoch", 10 ** 9) < e:
+                world = int(r["world"])
+        return [([], world, None)]
+
+    mesh1 = dist.data_mesh(num_devices=1)
+    model, opt = Net(), SGD(defaults.optim.momentum)
+    state = create_train_state(model, jax.random.PRNGKey(seed),
+                               np.zeros((1, 32, 32, 3), np.float32), opt)
+    step = make_train_step(model, opt, mesh1, make_schedule(
+        "constant", defaults.optim.lr, 1, 0, 0.0))
+    for epoch in range(epochs):
+        for prior, world, steps in segments_for_epoch(epoch):
+            segs = streams(epoch, prior, world)
+            n = (min(len(s) for s in segs) // batch
+                 if steps is None else steps)
+            for k in range(n):
+                sel = np.concatenate(
+                    [s[k * batch:(k + 1) * batch] for s in segs])
+                state, _ = step(state, {"image": ds.images[sel],
+                                        "label": ds.labels[sel]})
+    return state
+
+
+def main() -> int:
+    import os
+
+    art = REPO / "artifacts"
+    art.mkdir(parents=True, exist_ok=True)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    keep = os.environ.get("TPU_DP_SMOKE_DIR")
+    tmp = (Path(keep) if keep
+           else Path(tempfile.mkdtemp(prefix="tpu_dp_grow_smoke.")))
+    tmp.mkdir(parents=True, exist_ok=True)
+    script = tmp / "worker.py"
+    script.write_text(_WORKER)
+    ckpt = tmp / "ck"
+    outs = [tmp / f"out{r}.pkl" for r in range(3)]
+    rejoin_out = tmp / "out2_rejoin.pkl"
+
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    env.pop("TPU_DP_FAULT", None)
+    t0 = time.time()
+
+    def spawn(rank, out_path, join=False):
+        argv = [sys.executable, str(script), str(rank), port, str(ckpt),
+                str(out_path)] + (["join"] if join else [])
+        return subprocess.Popen(argv, cwd=REPO, env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+
+    procs = [spawn(r, outs[r]) for r in range(3)]
+    failures: list[str] = []
+    logs: dict[str, str] = {}
+
+    # The external SIGTERM: wait for training to be underway (rank 2's
+    # heartbeat file), then deliver — the delay: fault pins the window.
+    hb = ckpt / "obs" / "heartbeat_r00002.jsonl"
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        if hb.exists() and hb.read_text().count("\n") >= 1:
+            break
+        if any(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.05)
+    procs[2].send_signal(signal.SIGTERM)
+
+    # The relaunch, immediately — the way an eager supervisor would. The
+    # joiner's admission handshake tolerates the shrink still being in
+    # flight (it waits for the membership record that excludes sid 2,
+    # then requests admission to the next epoch).
+    rejoin = spawn(2, rejoin_out, join=True)
+
+    try:
+        for name, p in [("r0", procs[0]), ("r1", procs[1]),
+                        ("r2-old", procs[2]), ("r2-rejoin", rejoin)]:
+            logs[name] = p.communicate(timeout=300)[0].decode()
+    except subprocess.TimeoutExpired:
+        for p in procs + [rejoin]:
+            if p.poll() is None:
+                p.kill()
+        print("FAIL: grow smoke timed out", file=sys.stderr)
+        for name, log in logs.items():
+            print(f"--- {name}\n{log[-2000:]}", file=sys.stderr)
+        return 1
+
+    want = {"r0": (procs[0], 0), "r1": (procs[1], 0),
+            "r2-old": (procs[2], 143), "r2-rejoin": (rejoin, 0)}
+    for name, (p, rc) in want.items():
+        if p.returncode != rc:
+            failures.append(f"{name}: exit {p.returncode} != {rc}")
+
+    results = {}
+    for r, path in ((0, outs[0]), (1, outs[1]), (2, rejoin_out)):
+        if path.exists():
+            results[r] = pickle.loads(path.read_bytes())
+        else:
+            failures.append(f"rank {r}: no result dump")
+
+    records: list[dict] = []
+    worlds: list[int] = []
+    mem_root = ckpt / "membership"
+    gen_dirs = sorted(mem_root.iterdir()) if mem_root.exists() else []
+    if len(gen_dirs) == 1:
+        records = [json.loads(p.read_text())
+                   for p in sorted(gen_dirs[0].glob("epoch_*.json"))]
+        worlds = [r["world"] for r in records]
+        if worlds != [3, 2, 3]:
+            failures.append(f"world history {worlds} != [3, 2, 3]")
+        else:
+            if [d["sid"] for d in records[1]["departed"]] != [2]:
+                failures.append(f"shrink departed: {records[1]['departed']}")
+            if (records[2]["reason"] != "grow"
+                    or [j["sid"] for j in records[2]["joined"]] != [2]):
+                failures.append(f"grow record wrong: {records[2]}")
+    else:
+        failures.append(f"expected one ledger generation, got {gen_dirs}")
+
+    if len(results) == 3:
+        digests = {r: results[r]["digest"] for r in results}
+        if len(set(digests.values())) != 1:
+            failures.append(f"final params diverged across ranks: {digests}")
+        if any(results[r]["world"] != 3 for r in results):
+            failures.append(
+                f"not everyone ended at world 3: "
+                f"{ {r: results[r]['world'] for r in results} }")
+        if records and not failures:
+            import jax
+
+            oracle = _oracle_params(records, num_examples=96)
+            for x, y in zip(
+                jax.tree_util.tree_leaves(results[0]["params"]),
+                jax.tree_util.tree_leaves(oracle.params),
+            ):
+                if not np.allclose(np.asarray(x), np.asarray(y),
+                                   atol=2e-5):
+                    failures.append("final params do not match the "
+                                    "single-device 3→2→3 oracle")
+                    break
+
+    # obsctl timeline from the artifacts alone: the grow story in order.
+    timeline_kinds: list[str] = []
+    try:
+        from tpu_dp.obs import obsctl
+
+        out = obsctl.build_timeline(obsctl.RunArtifacts(ckpt))
+        timeline_kinds = [e["kind"] for e in out["events"]]
+        story = ["elastic_departure", "elastic_regroup", "rank_joined",
+                 "elastic_grow"]
+        positions = [timeline_kinds.index(k) for k in story]
+        positions.append(len(timeline_kinds) - 1
+                         - timeline_kinds[::-1].index("epoch_complete"))
+        if positions != sorted(positions):
+            failures.append(f"timeline story out of order: "
+                            f"{list(zip(story, positions))}")
+        (art / "elastic_grow_timeline.json").write_text(json.dumps(out))
+    except Exception as e:  # noqa: BLE001 — verdict, not crash
+        failures.append(f"obsctl timeline failed: {e}")
+
+    report = {
+        "ok": not failures,
+        "failures": failures,
+        "wall_s": round(time.time() - t0, 1),
+        "exit_codes": {n: p.returncode for n, (p, _) in want.items()},
+        "world_history": worlds,
+        "membership_records": records,
+        "timeline_events": len(timeline_kinds),
+        "counters": {r: {k: v for k, v in results[r]["counters"].items()
+                         if k.startswith("elastic")}
+                     for r in results},
+    }
+    (art / "elastic_grow_report.json").write_text(
+        json.dumps(report, indent=2, default=str))
+    print(f"elastic grow smoke: {'OK' if not failures else 'FAIL'} "
+          f"({report['wall_s']}s) — artifacts/elastic_grow_report.json")
+    if failures:
+        for f in failures:
+            print(f"  FAIL: {f}", file=sys.stderr)
+        for name, log in logs.items():
+            print(f"--- {name}\n{log[-2500:]}", file=sys.stderr)
+        return 1
+    if not keep:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

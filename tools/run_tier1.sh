@@ -30,6 +30,22 @@
 #                                    # -m elastic tests (protocol units
 #                                    # AND the 3-process subprocess
 #                                    # suite).
+#   tools/run_tier1.sh --elastic-grow # elastic grow lane: the full
+#                                    # preempt→shrink→relaunch→regrow
+#                                    # round trip — 3 CPU processes, a
+#                                    # REAL external SIGTERM to rank 2
+#                                    # mid-training, a REAL relaunch that
+#                                    # rejoins through the membership
+#                                    # ledger; asserts world 3→2→3, final
+#                                    # params vs the single-device oracle
+#                                    # (atol 2e-5), and that `obsctl
+#                                    # timeline` reconstructs departure →
+#                                    # regroup → join → grow-regroup →
+#                                    # completion from artifacts alone.
+#                                    # Archives artifacts/
+#                                    # elastic_grow_report.json (+ the
+#                                    # timeline), then the -m elastic
+#                                    # tests.
 #   tools/run_tier1.sh --guard       # guardrails lane: two exit-coded
 #                                    # smokes — NaN-skip (injected
 #                                    # nan:step=3, action=skip: the run
@@ -161,6 +177,18 @@ if [ "${1:-}" = "--elastic" ]; then
     # included.
     mkdir -p artifacts
     env JAX_PLATFORMS=cpu python tools/elastic_smoke.py || exit $?
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m elastic \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--elastic-grow" ]; then
+    # The smoke is its own verdict (exit 1 when any check of the
+    # SIGTERM→relaunch→regrow round trip fails); the archived report and
+    # timeline are the CI record of the grow. Then the full elastic
+    # suite (grow protocol units, fencing, the 3-process relaunch
+    # acceptance, and the joiner-crash fallback).
+    mkdir -p artifacts
+    env JAX_PLATFORMS=cpu python tools/elastic_grow_smoke.py || exit $?
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m elastic \
         -p no:cacheprovider
 fi

@@ -215,9 +215,26 @@ class ResilienceConfig:
     # Host the new leader advertises for the regrouped coordinator
     # ("" = keep loopback on single-host topologies, else hostname).
     elastic_coordinator_host: str = ""
-    # Re-verify the DP304 collective-schedule fingerprint on the shrunk
+    # Re-verify the DP304 collective-schedule fingerprint on the re-formed
     # mesh before the first post-regroup step (one AOT compile per regroup).
     elastic_verify_fingerprint: bool = True
+    # Grow-flavor regroups (docs/RESILIENCE.md "Grow"): whether a starting
+    # process tries to JOIN a live run through the membership ledger
+    # instead of bootstrapping a fresh one. "auto": join when the newest
+    # generation's current membership excludes this rank's stable id (the
+    # relaunched-after-preemption signature); "always": join or die with a
+    # typed error (the explicit supervisor relaunch command); "never":
+    # classic bootstrap only.
+    elastic_join: str = "auto"  # auto | always | never
+    # Bound on the joiner's admission wait per attempt (0 = use
+    # regroup_timeout_s). The member side bounds the handshake with
+    # regroup_timeout_s either way — a half-dead joiner cannot wedge the
+    # quiesce (its bootstrap times out and the incumbents re-form at
+    # world N).
+    elastic_join_timeout_s: float = 0.0
+    # Refuse to grow beyond this world size (0 = unbounded): a join that
+    # would exceed it is refused with a typed reason in the ledger.
+    elastic_max_world: int = 0
 
 
 @dataclass

@@ -117,10 +117,18 @@ def elastic_resplit(
     ``steps_1`` over ``world_1``, … Each segment consumes
     ``steps * per_step`` indices from every one of its shards
     (``per_step`` = per-process batch × grad-accum microbatches — constant
-    across regroups; the *global* batch is what shrinks). Replaying the
+    across regroups; the *global* batch is what changes). Replaying the
     lineage is pure arithmetic over the epoch's seeded permutation, so a
     third regroup (or a restart resuming into a re-split tail) reconstructs
     the exact remaining set from ``(seed, epoch, lineage)`` alone.
+
+    The construction is direction-agnostic: ``new_world`` may be smaller
+    than the last segment's world (a shrink), larger (a GROW — a
+    preempted rank rejoined, `tpu_dp.resilience.elastic` "grow" flavor),
+    or cross either way repeatedly (shrink→grow→grow lineages); the
+    re-striding, pad fidelity, and min-shard truncation below hold for
+    every N→M hop, proven against the single-device oracle in
+    `tests/test_elastic.py` and `tests/test_multiprocess.py`.
 
     Construction, per segment: pad the current remaining stream by
     wraparound to a multiple of the segment's world and shard it

@@ -78,8 +78,18 @@ DUMP_GLOB = "flightrec_r*.json"
 DUMP_REQUEST = "dump_request.json"
 
 
-def dump_path_for(dump_dir: str | os.PathLike, rank: int) -> Path:
-    return Path(dump_dir) / f"flightrec_r{int(rank):05d}.json"
+def dump_path_for(dump_dir: str | os.PathLike, rank: int,
+                  tag: str = "") -> Path:
+    """Dump path for a (stable rank, incarnation tag) pair.
+
+    ``tag`` distinguishes incarnations that legitimately share a stable
+    rank: a preempted rank that REJOINS the run (elastic grow) must not
+    overwrite its predecessor's departure dump — the departure is exactly
+    the forensic record the rejoin story needs. Tagged names still match
+    `DUMP_GLOB`, so obsctl reads both incarnations.
+    """
+    suffix = f"_{tag}" if tag else ""
+    return Path(dump_dir) / f"flightrec_r{int(rank):05d}{suffix}.json"
 
 
 class FlightRecorder:
@@ -90,6 +100,7 @@ class FlightRecorder:
         self._events: deque[dict] = deque(maxlen=self.capacity)
         self.total_recorded = 0   # lifetime count, beyond the ring
         self.rank = 0
+        self.tag = ""             # incarnation tag (elastic rejoin)
         self.dump_dir: Path | None = None
         self.run: dict[str, Any] = {}
         self.dumps = 0
@@ -100,7 +111,8 @@ class FlightRecorder:
                   dump_dir: str | os.PathLike | None = None,
                   capacity: int | None = None,
                   run: dict | None = None,
-                  fresh: bool = False) -> "FlightRecorder":
+                  fresh: bool = False,
+                  tag: str = "") -> "FlightRecorder":
         """Set identity + dump target (the Trainer calls this at startup).
 
         ``fresh=True`` marks a RUN boundary: the ring is cleared so a new
@@ -109,7 +121,9 @@ class FlightRecorder:
         contents — an elastic regroup re-homes the observers mid-run, and
         the pre-regroup events are exactly the forensics a later dump
         must carry. ``capacity`` changes rebuild the ring (contents
-        preserved up to the new bound).
+        preserved up to the new bound). ``tag`` names this incarnation's
+        dump file (`dump_path_for`) — a rejoined rank must not overwrite
+        its predecessor's departure dump.
         """
         if fresh:
             self._events.clear()
@@ -118,6 +132,7 @@ class FlightRecorder:
             self._req_handled = 0.0
         self.enabled = True
         self.rank = int(rank)
+        self.tag = str(tag)
         self.dump_dir = None if dump_dir is None else Path(dump_dir)
         if fresh and self.dump_dir is not None:
             # A dump_request.json left behind by a PREVIOUS incarnation (a
@@ -186,7 +201,7 @@ class FlightRecorder:
         if path is None:
             if self.dump_dir is None:
                 return None
-            path = dump_path_for(self.dump_dir, self.rank)
+            path = dump_path_for(self.dump_dir, self.rank, tag=self.tag)
         out = Path(path)
         try:
             from tpu_dp.obs.counters import counters
@@ -194,6 +209,7 @@ class FlightRecorder:
             payload = {
                 "schema": SCHEMA,
                 "rank": self.rank,
+                "tag": self.tag,
                 "reason": str(reason),
                 "ts": time.time(),
                 "run": self.run,
@@ -249,6 +265,7 @@ class FlightRecorder:
         self.run = {}
         self.dump_dir = None
         self.rank = 0
+        self.tag = ""
 
 
 #: The process-wide recorder every subsystem publishes into.

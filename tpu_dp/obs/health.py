@@ -195,6 +195,19 @@ class HealthMonitor:
         self.on_flag = on_flag
         self._log = logger
         self._start = time.time()
+        # Per-rank admission times (elastic grow): a freshly admitted
+        # rank has no heartbeat history, and judging its absence against
+        # the MONITOR's start time would flag it "missing" the moment the
+        # global startup grace expired — exactly the window in which a
+        # joiner is still compiling its first window. `admit` extends the
+        # PR 5 startup-grace logic to the rank's own admission time.
+        self._admitted: dict[int, float] = {}
+
+    def admit(self, rank: int, ts: float | None = None) -> None:
+        """Mark ``rank`` as (re)admitted at ``ts`` (default: now): its
+        "missing" startup grace restarts from that moment instead of the
+        monitor's construction time."""
+        self._admitted[int(rank)] = time.time() if ts is None else float(ts)
 
     # -- reading -------------------------------------------------------
 
@@ -296,17 +309,19 @@ class HealthMonitor:
         now = time.time() if now is None else float(now)
         by_rank = self.read_beats(tail_bytes=self.TAIL_BYTES)
         issues: list[HealthIssue] = []
-        grace_over = now - self._start > self.stale_after_s
         for rank in range(self.world):
             # Host-only aggregation: the monitor is collective-free by
             # design (it must work when collectives are what's wedged).
             # The startup grace keeps the first checks — which can run
             # before any rank finishes its compile-heavy first window —
-            # from flagging a healthy, still-warming run.
-            if rank not in by_rank and grace_over:  # dplint: allow(DP101)
+            # from flagging a healthy, still-warming run; a rank admitted
+            # mid-run (elastic grow) gets the same grace from ITS
+            # admission time, not the monitor's birth.
+            since = self._admitted.get(rank, self._start)
+            if rank not in by_rank and now - since > self.stale_after_s:  # dplint: allow(DP101)
                 issues.append(HealthIssue(
                     kind="missing", rank=rank,
-                    age_s=round(now - self._start, 3),
+                    age_s=round(now - since, 3),
                 ))
         fresh: dict[int, dict] = {}
         for rank, beats in sorted(by_rank.items()):

@@ -60,6 +60,11 @@ MARKER_KINDS = (
     "guard_sdc", "guard_spike", "guard_quarantine", "guard_tombstone",
     "guard_trigger", "guard_rollback", "guard_halt", "eviction",
     "membership_epoch", "elastic_regroup", "elastic_departure",
+    # the grow half (docs/RESILIENCE.md "Grow"): a preempted rank's
+    # departure→join→grow-regroup round trip must be reconstructable
+    # from artifacts alone, refusals (fencing verdicts) included.
+    "elastic_grow", "rank_joined", "elastic_join", "elastic_join_request",
+    "join_refused",
     "preempt_signal", "preempt_exit", "dump_request", "exit",
     # the serving tier's lifecycle (tpu_dp/serve/router.py): drain →
     # failover → swap must be reconstructable from artifacts alone.
@@ -77,7 +82,8 @@ MARKER_KINDS = (
 _REPLICATED_KINDS = frozenset({
     "guard_sdc", "guard_spike", "guard_quarantine", "guard_tombstone",
     "guard_trigger", "guard_halt", "guard_rollback",
-    "elastic_trigger", "elastic_regroup", "epoch_start", "snapshot",
+    "elastic_trigger", "elastic_regroup", "elastic_grow",
+    "epoch_start", "snapshot",
 })
 
 _ME_DIR_RE = re.compile(r"^me(\d+)$")
@@ -208,10 +214,13 @@ class RunArtifacts:
 
     def membership_records(self) -> list[dict]:
         """Every membership-epoch record across ledger generations."""
+        return self._ledger_files("*/epoch_*.json")
+
+    def _ledger_files(self, pattern: str) -> list[dict]:
         if not self.membership_dir.is_dir():
             return []
         out = []
-        for path in sorted(self.membership_dir.glob("*/epoch_*.json")):
+        for path in sorted(self.membership_dir.glob(pattern)):
             try:
                 rec = json.loads(path.read_text())
             except (OSError, ValueError):
@@ -220,6 +229,18 @@ class RunArtifacts:
                 rec["_ledger_generation"] = path.parent.name
                 out.append(rec)
         return out
+
+    def join_requests(self) -> list[dict]:
+        """Every join request across ledger generations — the request
+        file IS the durable record of the admission attempt (the joiner's
+        own flight recorder starts fresh after its admission, so the
+        request leg of the story lives on the ledger, not in a dump)."""
+        return self._ledger_files("*/join_e*_r*.json")
+
+    def join_refusals(self) -> list[dict]:
+        """Every fencing refusal across ledger generations — a refused
+        zombie/seat-conflict claim is part of the run's story too."""
+        return self._ledger_files("*/join_refused_*.json")
 
 
 # --------------------------------------------------------------------------
@@ -324,6 +345,23 @@ def build_timeline(art: RunArtifacts, include_steps: bool = False) -> dict:
             add("eviction", ts, "membership", rank=dep.get("sid"),
                 detail={"membership_epoch": epoch,
                         "reason": dep.get("reason")})
+        for joined in rec.get("joined") or ():
+            add("rank_joined", ts, "membership", rank=joined.get("sid"),
+                detail={"membership_epoch": epoch,
+                        "world": rec.get("world"),
+                        "token": str(joined.get("token", ""))[:8]})
+
+    # -- join requests + refusals (the admission story) -----------------
+    for rec in art.join_requests():
+        add("elastic_join_request", _parse_ts(rec.get("ts")), "membership",
+            rank=rec.get("sid"),
+            detail={"generation": rec.get("generation"),
+                    "token": str(rec.get("token", ""))[:8]})
+    for rec in art.join_refusals():
+        add("join_refused", _parse_ts(rec.get("ts")), "membership",
+            rank=rec.get("sid"),
+            detail={"reason": rec.get("reason"), "by": rec.get("by"),
+                    "generation": rec.get("_ledger_generation")})
 
     # -- flight-recorder dumps ------------------------------------------
     # Dump "step" cadence events are NOT timeline step events: the

@@ -335,11 +335,30 @@ _ELASTIC_HEARTBEAT_S = 600
 _ELASTIC_MAX_MISSING = 1_000_000
 
 
+def _park(*objs) -> None:
+    """Immortalize coordination client/service objects (idempotent).
+
+    The one safe disposal: their C++ destructors close sockets that
+    still-attached poll threads (ours and peers') escalate into process
+    termination, so abandoned/retired coordination objects are pinned for
+    the life of the process and the OS reclaims them at exit. Shared by
+    `abandon_distributed`, `park_distributed`, and the failed-bootstrap
+    path — one copy of a subtle refcount idiom, one dedup guard.
+    """
+    import ctypes
+
+    for obj in objs:
+        if obj is not None and not any(g is obj for g in _GRAVEYARD):
+            ctypes.pythonapi.Py_IncRef(ctypes.py_object(obj))
+            _GRAVEYARD.append(obj)
+
+
 def elastic_initialize(
     coordinator_address: str,
     num_processes: int,
     process_id: int,
     initialization_timeout: int = 60,
+    host_service: bool | None = None,
 ) -> DistContext:
     """Bootstrap (or re-bootstrap) a regroup-tolerant distributed context.
 
@@ -347,6 +366,13 @@ def elastic_initialize(
     `abandon_distributed` — unlike `jax.distributed.initialize`, which can
     only ever run once per process. ``num_processes == 1`` degrades to
     plain single-process mode (no coordination service at all).
+
+    ``host_service`` decides who runs the coordination service: None (the
+    default) keeps the dense-rank-0 convention; a grow regroup passes an
+    explicit bool because a joiner can land at dense rank 0 (stable ids
+    sort) while the coordinator address — published before the joiner was
+    reachable — names an incumbent's host (the membership record's
+    ``service_sid``).
     """
     from jax._src import distributed
 
@@ -372,7 +398,7 @@ def elastic_initialize(
         )
     from jax._src.lib import xla_extension as xe
 
-    if process_id == 0:
+    if host_service if host_service is not None else process_id == 0:
         st.service = xe.get_distributed_runtime_service(
             "[::]:" + coordinator_address.rsplit(":", 1)[1],
             num_processes,
@@ -391,10 +417,15 @@ def elastic_initialize(
         st.client.connect()
     except Exception as e:
         # A failed connect must leave the state re-initializable (the
-        # caller may retry on a fresh epoch record).
+        # caller may retry on a fresh epoch record — a grow whose joiner
+        # died mid-handshake falls back to re-forming at world N). The
+        # failed client/service are parked, not destroyed: peers that DID
+        # reach the half-formed service may still have poll machinery
+        # attached, and destroying coordination objects under attached
+        # peers escalates to process termination (see the module notes).
+        _park(st.client, st.service)
         st.client = None
-        if process_id == 0:
-            st.service = None
+        st.service = None
         raise RuntimeError(
             f"elastic bootstrap failed (coordinator {coordinator_address}, "
             f"process {process_id}/{num_processes}): {e}"
@@ -427,15 +458,10 @@ def abandon_distributed() -> None:
     them is the only safe disposal. Backends and compile caches are then
     cleared so the next `elastic_initialize` rebuilds the device view.
     """
-    import ctypes
-
     from jax._src import distributed
 
     st = distributed.global_state
-    for obj in (st.client, st.service):
-        if obj is not None:
-            ctypes.pythonapi.Py_IncRef(ctypes.py_object(obj))
-            _GRAVEYARD.append(obj)
+    _park(st.client, st.service)
     st.client = None
     st.service = None
     st.preemption_sync_manager = None
@@ -459,15 +485,10 @@ def park_distributed() -> None:
     (everything keeps working; the OS reclaims at exit) so destructors
     simply never run. Idempotent; no-op single-process.
     """
-    import ctypes
-
     from jax._src import distributed
 
     st = distributed.global_state
-    for obj in (st.client, st.service):
-        if obj is not None and not any(g is obj for g in _GRAVEYARD):
-            ctypes.pythonapi.Py_IncRef(ctypes.py_object(obj))
-            _GRAVEYARD.append(obj)
+    _park(st.client, st.service)
 
 
 def agree_token(name: str, make, timeout_s: float = 60.0) -> str:

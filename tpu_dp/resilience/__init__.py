@@ -37,9 +37,13 @@ from tpu_dp.resilience.elastic import (
     MEMBERSHIP_SCHEMA,
     ElasticCoordinator,
     ElasticError,
+    JoinOutcome,
     MembershipLedger,
     MembershipRecord,
     QuiescePlan,
+    find_live_generation,
+    maybe_join,
+    request_join,
 )
 from tpu_dp.resilience.faultinject import (
     KILL_EXIT_CODE,
@@ -73,6 +77,7 @@ __all__ = [
     "FaultPlan",
     "GuardPolicy",
     "GuardTrigger",
+    "JoinOutcome",
     "KILL_EXIT_CODE",
     "QuarantineLog",
     "MEMBERSHIP_SCHEMA",
@@ -89,7 +94,10 @@ __all__ = [
     "backoff_delays",
     "find_candidates",
     "find_latest",
+    "find_live_generation",
+    "maybe_join",
     "quarantine_save_dir",
+    "request_join",
     "resume_latest",
     "retry_call",
 ]

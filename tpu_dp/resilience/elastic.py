@@ -1,9 +1,6 @@
-"""Elastic world size: survive preemption by shrinking the mesh.
+"""Elastic world size: shrink the mesh on preemption, regrow it on return.
 
-The last robustness gap (ROADMAP item 3): the framework can snapshot on
-SIGTERM (PR 1), reshard optimizer state across world sizes (PR 4), and
-detect a missing rank via heartbeats (PR 5) — but a preempted rank still
-ends the run. This module closes the preempt→regroup loop: when a rank is
+The shrink half (PR 7) closed the preempt→regroup loop: when a rank is
 evicted, the survivors rendezvous through a **shared-filesystem membership
 ledger**, agree on a resume step, tear down and re-`initialize` the
 distributed context at world N-1 (`tpu_dp.parallel.dist.elastic_initialize`
@@ -12,6 +9,34 @@ resharding path, re-split the sampler over the survivors
 (`tpu_dp.data.sampler.elastic_resplit` — every remaining sample of the
 interrupted epoch visited exactly once), and re-verify the DP304 collective
 fingerprint on the shrunk mesh before the first post-regroup step.
+
+The **grow** half makes the protocol two-way: a relaunched (or newly
+launched) process discovers the live run through the same ledger
+(`find_live_generation`), publishes an exclusive-create *join request*
+fenced by the generation name and a fresh incarnation token
+(`request_join`), and the members quiesce exactly like a graceful shrink —
+same stop-threshold dance, plan flavor ``grow`` — then everyone
+(incumbents AND joiner) re-`initialize`s at world N+1, the joiner restores
+from the agreed quiesce snapshot (never its stale local disk), and the
+interrupted epoch's remainder is re-split over the grown world. The
+admission decision is the first protocol step where the ledger majority
+admits an outsider, so it is explicit about identity and fencing:
+
+- **identity** — a joiner *requests* a stable id (its launch process id);
+  the seat is granted only if no live member holds it ("reuse-if-free,
+  refuse-if-occupied"). A scale-up beyond the launch world simply requests
+  a fresh, unused sid.
+- **fencing** — the request must name the generation directory it targets
+  and carries a per-incarnation token; a zombie acting on a stale view (a
+  retired generation, a seat that is live again) is refused with a typed
+  ``join_refused_*`` record instead of admitted. The admitting epoch
+  record echoes the token, so a joiner can verify that *its* incarnation —
+  not a racing claimant of the same sid — was admitted.
+- **liveness** — the joiner cannot wedge the members: it is excluded from
+  the post-quiesce ack barrier, and a joiner that dies mid-handshake only
+  costs the incumbents the bounded bootstrap timeout, after which they
+  re-form at world N from the very snapshot the grow quiesce committed
+  (no work lost, no rollback — `ElasticCoordinator.establish_fallback`).
 
 Why a filesystem ledger and not collectives: regroup coordination must work
 exactly when collectives are the thing that is broken (a dead peer wedges
@@ -25,14 +50,19 @@ writer, so ranks can never disagree.
 Membership ledger layout (``<membership_dir>/<generation>/``)::
 
     epoch_0000.json      # membership record: epoch, members, coordinator,
-                         # departed, resume {steps_done, lineage, ...}
+                         # departed, joined, resume {steps_done, lineage, …}
     q_e0001_r00002.json  # quiesce check-in of stable rank 2 for the
                          # transition to epoch 1: step reached, leaving?
     plan_e0001.json      # the agreed transition plan (single writer,
-                         # exclusive-create: flavor, stop_step, survivors)
+                         # exclusive-create: flavor, stop_step, survivors,
+                         # joiners)
     q_e0001_r00002.done  # post-quiesce ack (final snapshot committed)
     left_r00002.json     # graceful-departure confirmation
     suspect_r00002.json  # a peer flagged dead (stale heartbeat) by rank 0
+    join_e0002_r00002.json     # a joiner's admission request for the
+                               # transition to epoch 2 (exclusive-create;
+                               # carries generation + incarnation token)
+    join_refused_e0002_r00002.json  # typed refusal (fencing verdict)
 
 A **generation** is one process incarnation of the job (a full restart via
 ``--resume=auto`` starts a new generation); membership epochs count
@@ -40,7 +70,8 @@ regroups within a generation. A rank's **stable id (sid)** is its process
 index at generation start — dense ranks are reassigned every epoch, sids
 never.
 
-Two regroup flavors, decided by the plan writer from the check-in set:
+Three regroup flavors, decided by the plan writer from the check-in set
+(plus the transition's validated join requests):
 
 - **graceful** — every member checked in (the departing rank announced
   itself: SIGTERM, ``TPU_DP_FAULT=preempt:``/``leave:``). All members keep
@@ -53,6 +84,13 @@ Two regroup flavors, decided by the plan writer from the check-in set:
   `PeerFailedError`, a stale heartbeat). The survivors cannot step (their
   collectives are wedged), so they resume from the newest *complete*
   snapshot; the steps since it are re-run on the shrunk mesh.
+- **grow** — a validated join request is pending and nobody is leaving.
+  Mechanically a graceful quiesce (stop threshold, final snapshot at the
+  agreed step) whose survivor set is members ∪ joiners; a transition that
+  has BOTH a leaver/departure and a join request resolves the shrink
+  first (the join defers to the next epoch — the joiner observes the
+  record forming without it and republishes; "shrink wins" is the
+  explicit answer to the join-during-shrink race).
 
 The failure matrix (who detects, who decides) is documented in
 docs/RESILIENCE.md "Elastic world size".
@@ -93,6 +131,16 @@ class MembershipRecord:
                                       #  "global_step", "snapshot_dir"}
     reason: str = "initial"
     ts: float = 0.0
+    #: admissions this epoch granted: [{"sid": s, "token": t}, ...] — the
+    #: token echo is the joiner's proof that ITS incarnation (not a racing
+    #: claimant of the same sid) was admitted.
+    joined: tuple[dict, ...] = ()
+    #: which member hosts the coordination service. None (pre-grow
+    #: records) means dense rank 0 — the shrink-era invariant, where the
+    #: epoch leader IS dense rank 0. A grow epoch can seat a joiner at
+    #: dense rank 0 (sids sort), and the service must stay on the
+    #: incumbent leader whose host the coordinator address names.
+    service_sid: int | None = None
 
     @property
     def world(self) -> int:
@@ -116,6 +164,8 @@ class MembershipRecord:
             "world": self.world,
             "coordinator": self.coordinator,
             "departed": list(self.departed),
+            "joined": list(self.joined),
+            "service_sid": self.service_sid,
             "resume": self.resume,
             "reason": self.reason,
             "ts": self.ts,
@@ -128,11 +178,14 @@ class MembershipRecord:
                 f"membership record schema {d.get('schema')!r} != "
                 f"{MEMBERSHIP_SCHEMA}"
             )
+        svc = d.get("service_sid")
         return cls(
             epoch=int(d["epoch"]),
             members=tuple(int(m) for m in d["members"]),
             coordinator=d.get("coordinator"),
             departed=tuple(d.get("departed") or ()),
+            joined=tuple(d.get("joined") or ()),
+            service_sid=None if svc is None else int(svc),
             resume=d.get("resume"),
             reason=str(d.get("reason", "")),
             ts=float(d.get("ts", 0.0)),
@@ -157,12 +210,19 @@ class QuiescePlan:
     """
 
     epoch: int                    # the NEW epoch being formed
-    flavor: str                   # "graceful" | "rollback"
+    flavor: str                   # "graceful" | "rollback" | "grow"
     stop_step: int                # global-step threshold (see above)
     train_epoch: int              # dataset epoch being interrupted
     leavers: tuple[int, ...]      # sids departing gracefully
     departed: tuple[dict, ...]    # sids that vanished ({"sid","reason"})
     survivors: tuple[int, ...]    # sids forming the new epoch
+    joiners: tuple[int, ...] = ()  # admitted outsiders (⊂ survivors; grow)
+
+    @property
+    def incumbents(self) -> tuple[int, ...]:
+        """Survivors that were already members — the set that holds the
+        live mesh, the resume truth, and (lowest sid) the leadership."""
+        return tuple(s for s in self.survivors if s not in self.joiners)
 
     def to_json(self) -> dict:
         return {
@@ -174,6 +234,7 @@ class QuiescePlan:
             "leavers": list(self.leavers),
             "departed": list(self.departed),
             "survivors": list(self.survivors),
+            "joiners": list(self.joiners),
         }
 
     @classmethod
@@ -185,40 +246,122 @@ class QuiescePlan:
             leavers=tuple(int(x) for x in d["leavers"]),
             departed=tuple(d["departed"]),
             survivors=tuple(int(x) for x in d["survivors"]),
+            joiners=tuple(int(x) for x in d.get("joiners") or ()),
         )
 
 
+#: bounded, jittered retry schedule for every ledger filesystem touch: a
+#: transient shared-FS error (NFS blip, ESTALE, EIO) must be a retry, not
+#: a spurious rollback regroup. The schedule (0.1+0.2+0.4+0.8+1.6 ≈ 3s
+#: plus jitter) absorbs a real server hiccup, not just a dropped packet;
+#: jitter breaks the stampede of a whole slice retrying the same hiccup
+#: in lockstep; attempts/retries/exhaustions land in the existing
+#: ``retry.*`` obs counters via `retry_call`. Exhaustion raises the typed
+#: `ElasticError` below for WRITES (a silently lost publish would stall
+#: the protocol until its timeout); exhausted READS degrade to "not
+#: readable yet" (None) — every read sits in a protocol-level poll loop
+#: already bounded by ``regroup_timeout_s``, so the poll cadence keeps
+#: retrying for far longer than any in-call schedule could.
+_IO_RETRIES = 5
+_IO_BASE_DELAY_S = 0.1
+_IO_JITTER = 0.5
+
+
+def _ledger_io(fn, describe: str):
+    """Run one ledger filesystem operation under the retry policy.
+
+    `FileNotFoundError` is an *answer* (record not written yet — the
+    protocol polls), never an error, so it propagates immediately for the
+    caller to interpret; every other OSError is retried with jittered
+    backoff and, once exhausted, wrapped in `ElasticError` so callers see
+    a typed give-up instead of a raw errno.
+    """
+    from tpu_dp.resilience.retry import retry_call
+
+    def attempt():
+        try:
+            return fn()
+        except FileNotFoundError:
+            raise
+        except OSError as e:
+            raise _RetryableLedgerIO(str(e)) from e
+
+    try:
+        return retry_call(
+            attempt, retries=_IO_RETRIES, base_delay=_IO_BASE_DELAY_S,
+            jitter=_IO_JITTER, retry_on=(_RetryableLedgerIO,),
+            describe=f"membership-ledger {describe}",
+        )
+    except _RetryableLedgerIO as e:
+        raise ElasticError(
+            f"membership-ledger {describe} failed after "
+            f"{_IO_RETRIES + 1} attempts: {e.__cause__}"
+        ) from e.__cause__
+
+
+class _RetryableLedgerIO(OSError):
+    """Internal marker: an OSError the ledger retry policy may re-attempt
+    (everything except FileNotFoundError, which is protocol state)."""
+
+
 def _atomic_write_json(path: Path, payload: dict) -> None:
-    tmp = path.with_name(path.name + f".tmp{os.getpid()}")
-    tmp.write_text(json.dumps(payload, indent=2, default=str))
-    os.replace(tmp, path)
+    text = json.dumps(payload, indent=2, default=str)
+
+    def write():
+        tmp = path.with_name(path.name + f".tmp{os.getpid()}")
+        tmp.write_text(text)
+        os.replace(tmp, path)
+
+    _ledger_io(write, f"write {path.name}")
 
 
 def _exclusive_write_json(path: Path, payload: dict) -> bool:
     """First-writer-wins publish; True when THIS call created the file.
 
     `os.link` of a private tmp onto the target is atomic-create on POSIX:
-    a losing writer gets EEXIST and adopts the canonical file instead.
+    a losing writer gets EEXIST and adopts the canonical file instead
+    (losing the race is an answer, not an error — never retried).
     """
-    tmp = path.with_name(path.name + f".tmp{os.getpid()}")
-    tmp.write_text(json.dumps(payload, indent=2, default=str))
-    try:
-        os.link(tmp, path)
-        return True
-    except FileExistsError:
-        return False
-    finally:
+    text = json.dumps(payload, indent=2, default=str)
+
+    def write():
+        tmp = path.with_name(path.name + f".tmp{os.getpid()}")
+        tmp.write_text(text)
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+            os.link(tmp, path)
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    return _ledger_io(write, f"claim {path.name}")
 
 
 def _read_json(path: Path) -> dict | None:
-    """Parse ``path``; None when absent or torn (caller re-polls)."""
+    """Parse ``path``; None when absent, torn, or unreadable past the
+    retry budget (the caller's poll loop re-reads at protocol cadence —
+    see the `_IO_RETRIES` note on why reads degrade instead of raising)."""
+
+    def read():
+        try:
+            text = path.read_text()
+        except FileNotFoundError:
+            return None
+        try:
+            return json.loads(text)
+        except ValueError:
+            return None  # torn write in flight; the next poll re-reads
+
     try:
-        return json.loads(path.read_text())
-    except (OSError, ValueError):
+        return _ledger_io(read, f"read {path.name}")
+    except ElasticError:
+        logger.warning("membership-ledger read of %s still failing past "
+                       "the retry budget; treating as not-yet-readable",
+                       path.name, exc_info=True)
         return None
 
 
@@ -319,6 +462,211 @@ class MembershipLedger:
             "sid": self.sid, "step": int(step), "ts": time.time(),
         })
 
+    # -- join (grow) ----------------------------------------------------
+
+    def _join_path(self, epoch: int, sid: int) -> Path:
+        return self.dir / f"join_e{int(epoch):04d}_r{int(sid):05d}.json"
+
+    def _refusal_path(self, epoch: int, sid: int) -> Path:
+        return (self.dir
+                / f"join_refused_e{int(epoch):04d}_r{int(sid):05d}.json")
+
+    def publish_join(self, epoch: int, sid: int, token: str,
+                     generation: str, host: str = "") -> bool:
+        """Claim the ``sid`` seat for the ``epoch`` transition (joiner
+        side). Exclusive-create: True when THIS incarnation's claim won;
+        False when another claimant already holds the seat for this
+        transition (read the file to see whose token)."""
+        return _exclusive_write_json(self._join_path(epoch, sid), {
+            "sid": int(sid), "token": str(token),
+            "generation": str(generation), "host": str(host),
+            "ts": time.time(),
+        })
+
+    def join_request(self, epoch: int, sid: int) -> dict | None:
+        return _read_json(self._join_path(epoch, sid))
+
+    def confirm_join_ready(self, epoch: int, sid: int) -> None:
+        """The joiner's point of no return: published immediately before
+        it enters the coordination connect. The incumbents gate THEIR
+        connect on this file because a connect with an absent party is
+        not a catchable failure — the coordination client LOG(FATAL)s the
+        whole process on a rendezvous timeout (see
+        `tests/test_multiprocess.py::test_unreachable_coordinator_fails_fast`)
+        — so "is the joiner actually coming?" must be answered on the
+        ledger, BEFORE anyone commits to the grown bootstrap. Retried
+        like every ledger write: a transient FS blip on the handshake's
+        most timing-sensitive write must not kill the joiner (and bill
+        the incumbents a full ready-wait timeout)."""
+        path = (self.dir
+                / f"join_ready_e{int(epoch):04d}_r{int(sid):05d}.json")
+        _ledger_io(path.touch, f"touch {path.name}")
+
+    def await_join_ready(self, epoch: int, sids: Sequence[int],
+                         timeout_s: float, poll_s: float = 0.05
+                         ) -> list[int]:
+        """Wait for every admitted joiner's ready signal; returns the
+        sids that never signalled (the caller aborts the grow for them)."""
+        deadline = time.monotonic() + timeout_s
+        pending = {int(s) for s in sids}
+        while pending and time.monotonic() <= deadline:
+            pending = {
+                s for s in pending
+                if not (self.dir
+                        / f"join_ready_e{int(epoch):04d}_r{s:05d}.json"
+                        ).exists()
+            }
+            if pending:
+                time.sleep(poll_s)
+        return sorted(pending)
+
+    def publish_grow_verdict(self, epoch: int, commit: bool,
+                             reason: str = "") -> None:
+        """The SINGLE decision on whether a grow epoch's bootstrap runs.
+
+        Published by the incumbent leader after its `await_join_ready`
+        wait. One decider, on the ledger: if every incumbent ran its own
+        ready-wait timer, a joiner signalling inside the timers' skew
+        window would split the incumbents between the world-N+1 bootstrap
+        and the world-N fallback — two camps that can never rendezvous.
+        """
+        _exclusive_write_json(
+            self.dir / f"grow_verdict_e{int(epoch):04d}.json",
+            {"commit": bool(commit), "reason": str(reason),
+             "by": self.sid, "ts": time.time()},
+        )
+
+    def await_grow_verdict(self, epoch: int, timeout_s: float,
+                           poll_s: float = 0.05) -> dict | None:
+        """The leader's published verdict, or None on timeout (leader
+        died mid-grow — the caller surfaces a typed error)."""
+        deadline = time.monotonic() + timeout_s
+        path = self.dir / f"grow_verdict_e{int(epoch):04d}.json"
+        while time.monotonic() <= deadline:
+            d = _read_json(path)
+            if d is not None:
+                return d
+            time.sleep(poll_s)
+        return None
+
+    def join_refusal(self, epoch: int, sid: int) -> dict | None:
+        return _read_json(self._refusal_path(epoch, sid))
+
+    def refuse_join(self, epoch: int, sid: int, reason: str) -> None:
+        """Publish the typed fencing verdict (idempotent, any member)."""
+        path = self._refusal_path(epoch, sid)
+        if not path.exists():
+            logger.warning(
+                "elastic: refusing join of sid %d for e%d: %s",
+                sid, epoch, reason,
+            )
+            _atomic_write_json(path, {
+                "sid": int(sid), "reason": str(reason),
+                "by": self.sid, "ts": time.time(),
+            })
+
+    def refuse_stale_joins(self, current_epoch: int,
+                           members: Sequence[int] = ()) -> None:
+        """Refuse join requests targeting transitions that ALREADY
+        completed — the real signature of a zombie acting on a stale
+        worldview (it read a retired record, so it targets an epoch the
+        live run is past). Only strictly-retired targets are refused
+        (``epoch < current``): a request at exactly the current epoch is
+        a shrink-deferred claim whose owner is re-targeting, and refusing
+        it would race its own retry. Spared, never refused: any claim
+        whose sid is a CURRENT member (``members``) — it was admitted,
+        possibly at a later epoch than it first targeted (a shrink-
+        deferred request leaves its first file behind) — and any claim
+        its own target epoch admitted. The generation-name check in
+        `validate_joins` stays as defense-in-depth for forged/copied
+        files; THIS check is the one a real zombie trips."""
+        import re
+
+        live = {int(m) for m in members}
+        for path in self.dir.glob("join_e*_r*.json"):
+            m = re.fullmatch(r"join_e(\d+)_r(\d+)\.json", path.name)
+            if m is None:
+                continue
+            epoch, sid = int(m.group(1)), int(m.group(2))
+            if epoch >= int(current_epoch) or sid in live:
+                continue
+            rec_d = _read_json(self._epoch_path(epoch))
+            if rec_d is not None and sid in (
+                int(x) for x in rec_d.get("members") or ()
+            ):
+                # A CONSUMED claim: this request was admitted by its
+                # target epoch — refusing it post-hoc would write a false
+                # "zombie" verdict into the forensic record for every
+                # successful grow.
+                continue
+            self.refuse_join(
+                epoch, sid,
+                f"stale epoch fencing: transition e{epoch} already "
+                f"completed (current membership epoch "
+                f"{int(current_epoch)}) — request built from a "
+                f"retired incarnation's view",
+            )
+
+    def validate_joins(self, epoch: int, members: Sequence[int],
+                       max_world: int = 0) -> dict[int, dict]:
+        """The ``epoch`` transition's admissible join requests, fencing
+        applied (member side; deterministic given the same inputs, so
+        every member computes the identical verdict):
+
+        - a request naming a different *generation* than this ledger's
+          directory is a zombie acting on a stale view — refused, never
+          admitted (the retired incarnation's state is fiction);
+        - a request for a sid that is currently a live member is a seat
+          conflict (a zombie member "rejoining" over itself) — refused;
+        - admissions beyond ``max_world`` (0 = unbounded) are refused
+          lowest-sid-first-admitted. Unlike the two checks above, the cap
+          verdict depends on which request files a member's glob snapshot
+          has seen, so racing claims can momentarily split the members'
+          views; the published refusal-finality rule below keeps any one
+          epoch's verdict from flapping, and the EPOCH RECORD is the
+          canonical admission truth (`request_join` checks it before any
+          refusal, so an admitted joiner never dies to a racing verdict).
+
+        Refusals are published as ``join_refused_*`` records so the
+        waiting claimant sees a typed verdict instead of a timeout.
+        """
+        members = {int(m) for m in members}
+        out: dict[int, dict] = {}
+        for path in sorted(self.dir.glob(f"join_e{int(epoch):04d}_r*.json")):
+            d = _read_json(path)
+            if d is None:
+                continue
+            sid = int(d["sid"])
+            if self._refusal_path(epoch, sid).exists():
+                # A published refusal is final for this transition: the
+                # claimant may already have acted on it, so a later poll
+                # must not flip the verdict (it re-requests next epoch).
+                continue
+            if str(d.get("generation", "")) != self.dir.name:
+                self.refuse_join(
+                    epoch, sid,
+                    f"stale generation fencing: request names "
+                    f"{d.get('generation')!r}, live generation is "
+                    f"{self.dir.name!r}",
+                )
+                continue
+            if sid in members:
+                self.refuse_join(
+                    epoch, sid,
+                    f"sid {sid} is a live member of this epoch "
+                    f"(seat conflict — a departed rank must be observed "
+                    f"departed before its seat can be re-claimed)",
+                )
+                continue
+            if max_world and len(members) + len(out) + 1 > int(max_world):
+                self.refuse_join(
+                    epoch, sid,
+                    f"world at resilience.elastic_max_world={max_world}",
+                )
+                continue
+            out[sid] = d
+        return out
+
     # -- quiesce --------------------------------------------------------
 
     def _q_path(self, epoch: int, sid: int) -> Path:
@@ -358,7 +706,8 @@ class MembershipLedger:
         return QuiescePlan.from_json(d) if d is not None else None
 
     def maybe_publish_plan(self, epoch: int, members: Sequence[int],
-                           train_epoch: int, timed_out: bool) -> None:
+                           train_epoch: int, timed_out: bool,
+                           max_world: int = 0) -> None:
         """Publish THE plan when this rank is the acting leader and the
         collection is ready (single exclusive writer).
 
@@ -369,6 +718,14 @@ class MembershipLedger:
         a slow second publisher loses and adopts the canonical file, so
         divergent local views (a check-in landing just after one rank's
         timeout) cannot fork the membership.
+
+        Grow: the transition's validated join requests become the plan's
+        ``joiners`` — but ONLY on an otherwise-clean transition. A plan
+        with leavers or departed members resolves the shrink alone
+        ("shrink wins"): growing through the same epoch would entangle
+        the joiner's bootstrap with a death it cannot see; the deferred
+        joiner observes the record forming without it and republishes for
+        the next epoch.
         """
         members = sorted(int(m) for m in members)
         seen = self.check_ins(epoch)
@@ -387,11 +744,17 @@ class MembershipLedger:
         rollback = bool(departed) or any(
             d["flavor"] == "rollback" for d in seen.values()
         )
+        joiners: tuple[int, ...] = ()
+        if not rollback and not leavers:
+            joiners = tuple(sorted(
+                self.validate_joins(epoch, members, max_world=max_world)
+            ))
         max_step = max(d["step"] for d in seen.values())
         max_window = max(int(d.get("window", 1)) for d in seen.values())
         plan = QuiescePlan(
             epoch=epoch,
-            flavor="rollback" if rollback else "graceful",
+            flavor=("rollback" if rollback
+                    else "grow" if joiners else "graceful"),
             # The stop THRESHOLD (see QuiescePlan) — far enough that no
             # still-stepping member can overshoot it before its next plan
             # poll; a lone member has nobody to overshoot, so it stops
@@ -406,7 +769,10 @@ class MembershipLedger:
             train_epoch=train_epoch,
             leavers=leavers,
             departed=tuple(departed),
-            survivors=tuple(s for s in sorted(seen) if s not in leavers),
+            survivors=tuple(sorted(
+                [s for s in seen if s not in leavers] + list(joiners)
+            )),
+            joiners=joiners,
         )
         _exclusive_write_json(
             self.dir / f"plan_e{int(epoch):04d}.json", plan.to_json()
@@ -520,6 +886,8 @@ class ElasticCoordinator:
         poll_every_steps: int = 1,
         coordinator_host: str = "",
         min_world: int = 1,
+        max_world: int = 0,
+        record: MembershipRecord | None = None,
     ):
         self.root = Path(membership_dir)
         self.ledger = MembershipLedger(self.root / generation, sid)
@@ -528,14 +896,49 @@ class ElasticCoordinator:
         self.poll_every_steps = max(1, int(poll_every_steps))
         self.coordinator_host = coordinator_host
         self.min_world = max(1, int(min_world))
+        self.max_world = max(0, int(max_world))
         self._initial_coordinator = coordinator_address
         self._poll_marker = -1
         self._q_started: float | None = None  # monotonic quiesce start
+        if record is not None:
+            # Attaching to a LIVE generation at its current epoch (the
+            # joiner's path): the record IS the membership truth — never
+            # write or wait for epoch 0.
+            self.record = record
+            return
         if self.sid == 0:
             self.ledger.write_initial(range(world), coordinator_address)
         # Non-leaders may race ahead of the leader's first write; tolerate
         # a short wait for the generation's epoch-0 record.
         self.record = self.ledger.await_epoch(0, timeout_s=regroup_timeout_s)
+
+    @classmethod
+    def attach(
+        cls,
+        membership_dir: str | os.PathLike,
+        generation: str,
+        sid: int,
+        record: MembershipRecord,
+        regroup_timeout_s: float = 60.0,
+        poll_every_steps: int = 1,
+        coordinator_host: str = "",
+        min_world: int = 1,
+        max_world: int = 0,
+    ) -> "ElasticCoordinator":
+        """Attach to a LIVE generation at its current epoch — the joiner's
+        constructor. Never writes (or waits for) epoch 0: the generation
+        exists, its membership is ``record``, and this sid was admitted by
+        it (`request_join`); the coordinator simply adopts that state so
+        every later transition (a further shrink, another grow, this
+        rank's own eventual departure) runs the standard protocol."""
+        return cls(
+            membership_dir, generation, sid,
+            world=record.world, coordinator_address=record.coordinator,
+            regroup_timeout_s=regroup_timeout_s,
+            poll_every_steps=poll_every_steps,
+            coordinator_host=coordinator_host,
+            min_world=min_world, max_world=max_world, record=record,
+        )
 
     # -- detection ------------------------------------------------------
 
@@ -543,10 +946,13 @@ class ElasticCoordinator:
         """Regroup trigger at a window boundary, or None.
 
         Returns "leave" (this rank was told to go — SIGTERM / injected),
-        "peer" (another member already checked in for the next epoch), or
-        "suspect" (a member was flagged dead). Ledger globbing is rate-
-        limited to every ``poll_every_steps`` boundary crossings; a local
-        leave request is never rate-limited.
+        "peer" (another member already checked in for the next epoch),
+        "suspect" (a member was flagged dead), or "join" (an outsider
+        published an admissible join request — fencing already applied,
+        refusals already written, so an invalid claim never starts a
+        quiesce). Ledger globbing is rate-limited to every
+        ``poll_every_steps`` boundary crossings; a local leave request is
+        never rate-limited.
         """
         if leave_requested:
             return "leave"
@@ -563,6 +969,19 @@ class ElasticCoordinator:
         if any(s in self.record.members
                for s in self.ledger.suspects(nxt)):
             return "suspect"
+        if self.ledger.validate_joins(nxt, self.record.members,
+                                      max_world=self.max_world):
+            return "join"
+        # Zombie hygiene, same rate-limited cadence but LEADER-ONLY (the
+        # verdicts are deterministic and idempotent — world-times
+        # redundant globbing would just multiply shared-FS metadata
+        # traffic): requests aimed at transitions this run already
+        # completed get a typed refusal so the stale claimant exits
+        # instead of waiting out its timeout (current members' old
+        # deferred claims are spared).
+        if self.sid == min(self.record.members):
+            self.ledger.refuse_stale_joins(self.record.epoch,
+                                           members=self.record.members)
         return None
 
     def mark_suspect(self, rank: int, reason: str) -> None:
@@ -613,16 +1032,17 @@ class ElasticCoordinator:
             self.ledger.maybe_publish_plan(
                 nxt, self.record.members, train_epoch,
                 timed_out=now > self._q_started + self.regroup_timeout_s,
+                max_world=self.max_world,
             )
             plan = self.ledger.try_plan(nxt)
         if plan is not None:
             self._q_started = None
             logger.warning(
                 "elastic quiesce e%d (%s): stop threshold %d, leavers=%s "
-                "departed=%s survivors=%s (sid %d)",
+                "departed=%s joiners=%s survivors=%s (sid %d)",
                 plan.epoch, plan.flavor, plan.stop_step, list(plan.leavers),
-                [d["sid"] for d in plan.departed], list(plan.survivors),
-                self.sid,
+                [d["sid"] for d in plan.departed], list(plan.joiners),
+                list(plan.survivors), self.sid,
             )
             return plan
         if now > self._q_started + 2 * self.regroup_timeout_s:
@@ -646,10 +1066,17 @@ class ElasticCoordinator:
             time.sleep(poll_s)
 
     def ack_and_await_quiesced(self, plan: QuiescePlan) -> None:
-        """Post-snapshot barrier over everyone still alive in the plan."""
+        """Post-snapshot barrier over everyone still alive in the plan.
+
+        Joiners are excluded: they never quiesced (nothing to ack) and a
+        half-dead joiner must not cost the members this wait on top of
+        the bounded bootstrap timeout that already fences it.
+        """
         self.ledger.ack_quiesced(plan.epoch)
         missing = self.ledger.await_quiesced(
-            plan.epoch, plan.leavers + plan.survivors,
+            plan.epoch,
+            [s for s in plan.leavers + plan.survivors
+             if s not in plan.joiners],
             timeout_s=self.regroup_timeout_s,
         )
         if missing:
@@ -670,7 +1097,12 @@ class ElasticCoordinator:
         ``resume`` (the new leader's view wins): epoch/steps_done/lineage/
         global_step/snapshot_dir — everything a survivor needs to reload
         and re-split. The new coordinator lands on the leader's host at a
-        freshly-probed port (world 1 needs none).
+        freshly-probed port (world 1 needs none). The leader is the
+        lowest *incumbent* sid — a joiner can hold the lowest sid overall
+        (sid 0 rejoining), but only an incumbent holds the live mesh, the
+        resume truth, and a host the peers can already reach, so the
+        coordination service is pinned to the leader via ``service_sid``
+        regardless of dense-rank order.
         """
         if len(plan.survivors) < self.min_world:
             raise ElasticError(
@@ -681,7 +1113,8 @@ class ElasticCoordinator:
             raise ElasticError(
                 f"establish() called on non-survivor sid {self.sid}"
             )
-        leader = min(plan.survivors)
+        incumbents = plan.incumbents or plan.survivors
+        leader = min(incumbents)
         if self.sid == leader:
             coordinator = None
             if len(plan.survivors) > 1:
@@ -698,6 +1131,10 @@ class ElasticCoordinator:
             # accusation as its reason; a plain preemption stays labelled
             # as such.
             suspects = self.ledger.suspects(plan.epoch)
+            requests = {
+                s: self.ledger.join_request(plan.epoch, s) or {}
+                for s in plan.joiners
+            }
             rec = MembershipRecord(
                 epoch=plan.epoch, members=plan.survivors,
                 coordinator=coordinator,
@@ -707,12 +1144,60 @@ class ElasticCoordinator:
                         "reason": suspects.get(s, "preempted (graceful)")}
                        for s in plan.leavers]
                 ),
+                joined=tuple(
+                    {"sid": s, "token": str(requests[s].get("token", ""))}
+                    for s in plan.joiners
+                ),
+                service_sid=leader,
                 resume=resume, reason=plan.flavor, ts=time.time(),
             )
             self.record = self.ledger.publish_epoch(rec)
         else:
             self.record = self.ledger.await_epoch(
                 plan.epoch, timeout_s=self.regroup_timeout_s
+            )
+        return self.record
+
+    def establish_fallback(self, failed: MembershipRecord,
+                           reason: str) -> MembershipRecord:
+        """Abort a grow whose bootstrap failed: re-form at world N.
+
+        The grow record admitted joiners that never completed the
+        handshake (crashed mid-quiesce, died before connecting), so the
+        incumbents' ``reinitialize`` timed out — symmetrically on every
+        incumbent, since the coordination bootstrap completes only when
+        ALL parties connect. The incumbent leader publishes the corrective
+        epoch: same resume payload (the grow quiesce's final snapshot —
+        nothing is lost, nothing rolls back), members = incumbents only,
+        the would-be joiners attributed departed with the handshake
+        reason. A slow-but-alive joiner that wakes later observes the
+        corrective record forming without it and simply re-requests.
+        """
+        joined = tuple(int(j["sid"]) for j in failed.joined)
+        incumbents = tuple(s for s in failed.members if s not in joined)
+        if not incumbents or self.sid not in incumbents:
+            raise ElasticError(
+                f"grow fallback from e{failed.epoch}: sid {self.sid} is "
+                f"not an incumbent (members {list(failed.members)}, "
+                f"joined {list(joined)})"
+            )
+        leader = min(incumbents)
+        epoch = failed.epoch + 1
+        if self.sid == leader:
+            coordinator = None
+            if len(incumbents) > 1:
+                host = self.coordinator_host or self._default_host()
+                coordinator = f"{host}:{free_port(host)}"
+            rec = MembershipRecord(
+                epoch=epoch, members=incumbents, coordinator=coordinator,
+                departed=tuple({"sid": s, "reason": reason} for s in joined),
+                service_sid=leader,
+                resume=failed.resume, reason="grow_aborted", ts=time.time(),
+            )
+            self.record = self.ledger.publish_epoch(rec)
+        else:
+            self.record = self.ledger.await_epoch(
+                epoch, timeout_s=self.regroup_timeout_s
             )
         return self.record
 
@@ -745,6 +1230,321 @@ class ElasticCoordinator:
         ctx = dist.elastic_initialize(
             rec.coordinator or "", rec.world, rank,
             initialization_timeout=int(self.regroup_timeout_s),
+            # Pre-grow records (service_sid None) keep the dense-rank-0
+            # default; grow records pin the service to the incumbent
+            # leader whose host the coordinator address names.
+            host_service=(None if rec.service_sid is None
+                          else rec.service_sid == self.sid),
         )
         _counters.gauge("elastic.membership_epoch", rec.epoch)
         return ctx
+
+
+# ---------------------------------------------------------------------------
+# Joiner bootstrap: discovery → join request → admission → re-initialize.
+# ---------------------------------------------------------------------------
+
+
+def find_live_generation(membership_root: str | os.PathLike
+                         ) -> tuple[Path, MembershipRecord] | None:
+    """The newest generation under ``membership_root`` and its current
+    membership record, or None when the ledger is empty/unreadable.
+
+    "Newest" is decided by the epoch records' own publish timestamps (the
+    only clock every incarnation stamped), not directory mtime — archival
+    copies or a lagging shared FS must not elect a retired incarnation.
+    """
+    root = Path(membership_root)
+    if not root.is_dir():
+        return None
+    best: tuple[float, Path, MembershipRecord] | None = None
+    for gen_dir in root.iterdir():
+        if not gen_dir.is_dir():
+            continue
+        try:
+            rec = MembershipLedger(gen_dir, sid=-1).current()
+        except ElasticError:
+            continue
+        if best is None or rec.ts > best[0]:
+            best = (rec.ts, gen_dir, rec)
+    if best is None:
+        return None
+    return best[1], best[2]
+
+
+def request_join(
+    gen_dir: str | os.PathLike,
+    sid: int,
+    timeout_s: float = 60.0,
+    poll_s: float = 0.1,
+    attempts: int = 3,
+    host: str = "",
+    alive_timeout_s: float | None = None,
+) -> tuple[MembershipRecord, str]:
+    """Run the joiner's half of the admission handshake (ledger only).
+
+    Publishes an exclusive-create join request for the generation's next
+    membership transition and waits for one of three typed outcomes per
+    attempt: **admitted** (an epoch record appears whose ``joined``
+    entries echo this incarnation's token → returned), **refused** (a
+    ``join_refused_*`` verdict → `ElasticError` carrying the members'
+    reason), or the transition forming **without us** (a shrink won the
+    race, or another claimant took the seat → re-target the next epoch).
+    A generation that answers nothing within ``timeout_s`` is presumed
+    dead and raises — admission is granted by live members, never assumed.
+
+    ``alive_timeout_s`` separates "is anyone serving this ledger?" from
+    "how long may a live quiesce take": once the members demonstrably
+    answered (a check-in or plan for the target transition appears), the
+    attempt's deadline extends to this bound — so a short liveness probe
+    (auto-join after a possible full restart) never abandons a grow
+    quiesce that is genuinely converging, which takes a stop-threshold's
+    worth of real training steps plus a snapshot.
+    """
+    import uuid
+
+    gen_dir = Path(gen_dir)
+    ledger = MembershipLedger(gen_dir, int(sid))
+    token = uuid.uuid4().hex
+    for attempt in range(max(1, int(attempts))):
+        # Per-attempt budget (the documented contract of
+        # resilience.elastic_join_timeout_s): losing a seat race or
+        # deferring to a shrink must not starve the next attempt.
+        deadline = time.monotonic() + float(timeout_s)
+        cur = ledger.current()
+        if int(sid) in cur.members:
+            # The seat is (still) live — either the departure record has
+            # not formed yet (we raced our own predecessor's eviction) or
+            # a zombie is asking for a seat it never left. Block until
+            # the next record forms (its content is re-read at the top of
+            # the next attempt), rather than claiming over a live member.
+            try:
+                ledger.await_epoch(
+                    cur.epoch + 1,
+                    timeout_s=max(0.5, deadline - time.monotonic()),
+                )
+            except ElasticError:
+                raise ElasticError(
+                    f"join: sid {sid} is a live member of "
+                    f"{gen_dir.name} epoch {cur.epoch} and no departure "
+                    f"record formed within {timeout_s:.0f}s — refusing to "
+                    f"claim a live seat (zombie fencing)"
+                ) from None
+            continue
+        target = cur.epoch + 1
+        if not ledger.publish_join(target, sid, token, gen_dir.name,
+                                   host=host):
+            claim = ledger.join_request(target, sid) or {}
+            if str(claim.get("token")) != token:
+                # Another incarnation holds the seat claim for this
+                # transition; let its handshake resolve, then re-target.
+                logger.warning(
+                    "join: sid %d seat for e%d already claimed by another "
+                    "incarnation; waiting for the transition", sid, target,
+                )
+        logger.warning("elastic join: sid %d requesting admission to %s "
+                       "e%d (token %s)", sid, gen_dir.name, target,
+                       token[:8])
+        extended = alive_timeout_s is None
+        while time.monotonic() < deadline:
+            if not extended and (
+                ledger.quiesce_triggered(target)
+                or ledger.try_plan(target) is not None
+            ):
+                # Members are demonstrably converging this transition:
+                # switch from the liveness-probe budget to the full
+                # quiesce budget (see the docstring).
+                extended = True
+                deadline = max(deadline,
+                               time.monotonic() + float(alive_timeout_s))
+                logger.warning(
+                    "join: members are converging e%d — extending the "
+                    "admission wait to %.0fs", target, alive_timeout_s,
+                )
+            # The epoch RECORD is canonical and checked FIRST: under
+            # racing claims the members' per-snapshot cap verdicts can
+            # momentarily disagree (one member refuses over max_world
+            # from a glob that saw more requests than the plan
+            # publisher's did), and an admitted joiner must never kill
+            # itself over a racing refusal the record supersedes.
+            rec_d = _read_json(ledger._epoch_path(target))
+            if rec_d is not None:
+                rec = MembershipRecord.from_json(rec_d)
+                if any(int(j.get("sid", -1)) == int(sid)
+                       and str(j.get("token")) == token
+                       for j in rec.joined):
+                    return rec, token
+                # The transition formed without this incarnation (shrink
+                # won, or a racing claimant was admitted): re-target.
+                logger.warning(
+                    "join: e%d formed without sid %d (reason %r); "
+                    "re-targeting e%d", target, sid, rec.reason, target + 1,
+                )
+                break
+            refusal = ledger.join_refusal(target, sid)
+            if refusal is not None:
+                raise ElasticError(
+                    f"join refused for sid {sid} (e{target}, "
+                    f"{gen_dir.name}): {refusal.get('reason')}"
+                )
+            time.sleep(poll_s)
+        else:
+            raise ElasticError(
+                f"join: no admission, refusal, or transition for sid "
+                f"{sid} within {timeout_s:.0f}s ({gen_dir.name} e{target}) "
+                f"— the run is dead, idle past the poll cadence, or the "
+                f"ledger is not shared"
+            )
+    raise ElasticError(
+        f"join: admission not granted after {attempts} transition "
+        f"attempt(s) for sid {sid} under {gen_dir.name}"
+    )
+
+
+@dataclasses.dataclass
+class JoinOutcome:
+    """Everything a joined Trainer needs from the admission handshake."""
+
+    coordinator: "ElasticCoordinator"
+    record: MembershipRecord
+    ctx: Any  # tpu_dp.parallel.dist.DistContext
+    token: str
+    generation: str
+
+
+def maybe_join(cfg) -> JoinOutcome | None:
+    """The Trainer-facing joiner bootstrap (``resilience.elastic_join``).
+
+    Decides whether this process should JOIN a live run instead of
+    bootstrapping one, and if so runs the whole handshake: ledger
+    discovery, fenced join request, admission wait, and the
+    re-`initialize` into the grown mesh. Returns None when this process
+    should take the classic bootstrap path:
+
+    - mode "never", or no membership ledger at all;
+    - the newest generation's current record already lists this sid as a
+      member — the full-restart signature (every rank of a restarted job
+      finds itself in the retired record; joining a dead generation would
+      hang all of them), and equally the single-process resume.
+
+    Mode "always" skips only the membership heuristic, not the fencing:
+    admission still comes from live members or a typed `ElasticError`.
+    """
+    res = cfg.resilience
+    mode = res.elastic_join
+    if mode not in ("auto", "always", "never"):
+        raise ValueError(
+            f"resilience.elastic_join must be auto|always|never, "
+            f"got {mode!r}"
+        )
+    if mode == "never":
+        return None
+    root = Path(res.membership_dir or
+                Path(cfg.train.ckpt_dir) / "membership")
+    sid = cfg.parallel.process_id
+    if sid is None:
+        sid = int(os.environ.get("TPU_DP_PROCESS_ID", -1))
+    if sid < 0:
+        if mode == "always":
+            raise ElasticError(
+                "resilience.elastic_join=always needs an explicit stable "
+                "id (parallel.process_id / TPU_DP_PROCESS_ID) to request"
+            )
+        return None
+    found = find_live_generation(root)
+    if found is None:
+        if mode == "always":
+            raise ElasticError(
+                f"resilience.elastic_join=always but no membership "
+                f"generation exists under {root}"
+            )
+        return None
+    gen_dir, current = found
+    if mode == "auto" and int(sid) in current.members:
+        # Full-restart (or plain resume) signature: this sid is still a
+        # member of the newest record. Every rank of a wholly-restarted
+        # job sees exactly this, and must bootstrap fresh rather than
+        # queue join requests against a generation nobody serves.
+        return None
+    # NOTE: no flightrec events here — the Trainer's configure(fresh=True)
+    # runs after this handshake and would wipe them; the durable record
+    # of the request is the ledger file itself (obsctl sources
+    # `elastic_join_request` from it), the admission is re-told into the
+    # fresh ring by `_complete_join`, and a fallback's reason lands in
+    # the process log below.
+    timeout = float(res.elastic_join_timeout_s or res.regroup_timeout_s)
+    probe = timeout
+    if mode == "auto" and not res.elastic_join_timeout_s:
+        # Auto's probe is a GUESS that the run is alive — and the guess
+        # is wrong exactly when the whole job restarted after a shrink
+        # (this sid was already departed from the newest, now-dead,
+        # record). Its peers are meanwhile waiting in the classic
+        # bootstrap, bounded by regroup_timeout_s; probing for the full
+        # regroup timeout would outlive them (their rendezvous timeout is
+        # a LOG(FATAL)) and livelock every supervisor round. A short
+        # probe answers "is anyone serving this ledger?" and falls back
+        # in time for the full-world bootstrap to converge — while
+        # `alive_timeout_s` below restores the full quiesce budget the
+        # moment the members demonstrably answer (a live grow takes a
+        # stop-threshold of real steps plus a snapshot, easily past any
+        # probe). An explicit elastic_join_timeout_s — or mode=always —
+        # overrides.
+        probe = min(timeout, 15.0)
+    try:
+        import socket as _socket
+
+        host = _socket.gethostname()
+    except OSError:
+        host = ""
+    try:
+        record, token = request_join(gen_dir, int(sid), timeout_s=probe,
+                                     host=host, alive_timeout_s=timeout)
+    except ElasticError as e:
+        if mode == "always":
+            raise
+        # Auto mode: an unanswered (or refused) probe means this is NOT
+        # the relaunched-joiner scenario — most likely the whole job
+        # restarted and the generation is dead. Fall back to the classic
+        # bootstrap, where the rest of the restarted world is waiting.
+        logger.warning(
+            "elastic join (auto): probe of %s failed (%s) — falling back "
+            "to the classic bootstrap", gen_dir.name, e,
+        )
+        return None
+    coord = ElasticCoordinator.attach(
+        root, gen_dir.name, int(sid), record,
+        regroup_timeout_s=res.regroup_timeout_s,
+        poll_every_steps=res.elastic_poll_every_steps,
+        coordinator_host=res.elastic_coordinator_host,
+        min_world=res.elastic_min_world,
+        max_world=res.elastic_max_world,
+    )
+    # If the incumbents already aborted this grow (we were too slow for
+    # their join_ready gate), a corrective record exists without us — our
+    # coordinator address will never be served; fail typed instead of
+    # letting the connect LOG(FATAL).
+    aborted = _read_json(coord.ledger._epoch_path(record.epoch + 1))
+    if aborted is not None and int(sid) not in (aborted.get("members") or ()):
+        raise ElasticError(
+            f"grow e{record.epoch} was aborted by the incumbents before "
+            f"this joiner signalled ready (epoch {record.epoch + 1} formed "
+            f"without sid {sid}); re-run to request again"
+        )
+    # The point of no return: signal "entering the coordination connect"
+    # so the incumbents commit to the grown bootstrap only for a joiner
+    # that is demonstrably alive NOW (`confirm_join_ready` rationale).
+    coord.ledger.confirm_join_ready(record.epoch, int(sid))
+    # A rejoining incarnation inside a still-live process (the `relaunch:`
+    # fault's in-process twin) carries the retired epoch's parked
+    # coordination client; a genuinely fresh process carries nothing.
+    # reinitialize() abandons whatever is there and bootstraps the grown
+    # mesh — blocking until every incumbent connects too.
+    ctx = coord.reinitialize(record)
+    _counters.inc("elastic.joins")
+    logger.warning(
+        "elastic join: sid %d admitted to %s e%d — world %d, dense rank "
+        "%d", sid, gen_dir.name, record.epoch, record.world,
+        record.rank_of(int(sid)),
+    )
+    return JoinOutcome(coordinator=coord, record=record, ctx=ctx,
+                       token=token, generation=gen_dir.name)

@@ -21,6 +21,14 @@ the latter so spawned worker processes inherit the plan)::
                              # polls — same regroup path, usable where a
                              # real SIGTERM can't be (in-process pytest,
                              # non-main threads)
+    relaunch:step=9,rank=2   # deterministic in-process twin of "the
+                             # preempted rank comes back": departs exactly
+                             # like leave:, then `train.trainer.run_elastic`
+                             # catches the PreemptedError and rejoins the
+                             # run through the membership ledger
+                             # (resilience.elastic_join) in the same OS
+                             # process — world N → N-1 → N with no external
+                             # supervisor
     delay:step=5,ms=250      # sleep 250ms once (straggler simulation)
     drop:step=7              # arm a one-shot collective drop (ring retry path)
     nan:step=4               # guardrail faults (require guard.enabled —
@@ -57,7 +65,8 @@ import time
 
 logger = logging.getLogger(__name__)
 
-_KINDS = ("kill", "preempt", "delay", "drop", "leave", "nan", "spike", "sdc")
+_KINDS = ("kill", "preempt", "delay", "drop", "leave", "relaunch",
+          "nan", "spike", "sdc")
 #: kinds the Trainer handles through the guardrail layer rather than
 #: `on_step`: nan/spike ride the sentinel's compiled injection seam
 #: (`train/step._inject_guard_fault`), sdc mutates the host-side params.
@@ -69,7 +78,7 @@ KILL_EXIT_CODE = 137
 
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
-    kind: str          # kill | preempt | delay | drop | leave | nan | spike | sdc
+    kind: str          # kill | preempt | delay | drop | leave | relaunch | nan | spike | sdc
     step: int          # global optimizer step the fault fires at (>=)
     rank: int = -1     # -1: every rank
     delay_ms: float = 0.0
@@ -176,10 +185,13 @@ class FaultInjector:
             time.sleep(plan.delay_ms / 1000.0)
         elif plan.kind == "drop":
             self._drop_armed = True
-        elif plan.kind == "leave":
+        elif plan.kind in ("leave", "relaunch"):
+            # relaunch departs exactly like leave; the "comes back" half
+            # is `train.trainer.run_elastic`, which keys off the fired
+            # plan's kind after the departure's PreemptedError.
             logger.warning(
-                "fault injection: elastic leave request on rank %d at "
-                "step %d", self.rank, global_step,
+                "fault injection: elastic %s request on rank %d at "
+                "step %d", plan.kind, self.rank, global_step,
             )
             self.leave_requested = True
 

@@ -63,6 +63,7 @@ def retry_call(
     retry_on: tuple[type[BaseException], ...] = (RuntimeError, OSError),
     describe: str = "",
     sleep: Callable[[float], None] = time.sleep,
+    jitter: float = 0.0,
     **kwargs: Any,
 ) -> Any:
     """Call ``fn`` with up to ``retries`` retries and exponential backoff.
@@ -74,9 +75,19 @@ def retry_call(
     retries would just multiply timeouts). The final failure re-raises the
     last exception; callers that want rank attribution catch it and raise
     `PeerFailedError` with their topology context.
+
+    ``jitter`` adds up to that fraction of each delay, uniformly random.
+    The default stays 0 (deterministic — multi-rank logs line up), but
+    shared-filesystem callers (the elastic membership ledger) pass a
+    nonzero jitter so every rank of a slice retrying the same NFS blip
+    does not re-stampede the server on the identical schedule.
     """
     name = describe or getattr(fn, "__name__", repr(fn))
     delays = backoff_delays(retries, base_delay, max_delay)
+    if jitter > 0.0:
+        import random
+
+        delays = [d * (1.0 + random.uniform(0.0, jitter)) for d in delays]
     last: BaseException | None = None
     for attempt in range(retries + 1):
         # Telemetry (tpu_dp.obs): every attempt counted; the split between

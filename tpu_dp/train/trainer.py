@@ -109,12 +109,39 @@ def _elastic_fatal_errors() -> tuple[type[BaseException], ...]:
 class Trainer:
     def __init__(self, cfg: Config, mesh=None):
         self.cfg = cfg
-        self.ctx = dist.initialize(
-            cfg.parallel.coordinator_address,
-            cfg.parallel.num_processes,
-            cfg.parallel.process_id,
-            elastic=cfg.resilience.elastic,
-        )
+        # Elastic grow (docs/RESILIENCE.md "Grow"): before any classic
+        # bootstrap, a starting process may instead JOIN a live run it
+        # discovers through the membership ledger — the relaunched-after-
+        # preemption path (`resilience.elastic_join`). The handshake
+        # (fenced join request → admission → re-initialize into the grown
+        # mesh) runs first because it replaces the bootstrap entirely:
+        # the joiner's world and dense rank exist only once the members
+        # admit it.
+        self._join = None
+        if cfg.resilience.elastic and mesh is None:
+            from tpu_dp.resilience.elastic import maybe_join
+
+            # Knowable-locally config errors must fail BEFORE the join
+            # handshake: past confirm_join_ready, a dying joiner bills
+            # the incumbents a whole quiesce + bootstrap timeout +
+            # fallback regroup. (Deeper, dataset-dependent validation
+            # still runs post-join; a joiner failing THERE costs the
+            # fleet one bounded aborted grow — documented trade.)
+            if not cfg.data.drop_remainder:
+                raise ValueError(
+                    "resilience.elastic requires data.drop_remainder=true "
+                    "(the mid-epoch re-split carries no weight masks)"
+                )
+            self._join = maybe_join(cfg)
+        if self._join is not None:
+            self.ctx = self._join.ctx
+        else:
+            self.ctx = dist.initialize(
+                cfg.parallel.coordinator_address,
+                cfg.parallel.num_processes,
+                cfg.parallel.process_id,
+                elastic=cfg.resilience.elastic,
+            )
         if mesh is not None and cfg.resilience.elastic:
             raise ValueError(
                 "resilience.elastic cannot rebuild a caller-injected mesh "
@@ -319,21 +346,34 @@ class Trainer:
             )
         # Elastic world size (tpu_dp/resilience/elastic.py): this rank's
         # stable id is its process index at generation start; dense ranks
-        # are reassigned per membership epoch, sids never. The epoch's
-        # consumption lineage and any re-split tail are maintained by the
-        # regroup machinery; all stay inert when elastic is off.
-        self.stable_rank = self.ctx.process_index
+        # are reassigned per membership epoch, sids never. A JOINER's
+        # stable id is the seat its admission granted — its dense rank at
+        # the grown epoch is whatever sorted-sid order assigns.
+        self.stable_rank = (
+            self._join.coordinator.sid if self._join is not None
+            else self.ctx.process_index
+        )
         self.elastic = None
         self._epoch_lineage: list[list[int]] = []  # [world, steps] segments
         self._elastic_tail: Any = None
         self._quiesce_plan = None
         self._q_flavor = "graceful"
-        if cfg.train.resume:
+        if cfg.train.resume and self._join is None:
             self._maybe_resume()
+        elif cfg.train.resume:
+            log0("elastic join: ignoring --resume — a joiner's state comes "
+                 "from the admitted membership record's snapshot, never "
+                 "its stale local disk")
         # Host-side mirror of state.step: the snapshot cadence and fault
         # steps key off it without a per-window device sync.
         self._host_step = int(self.state.step)
-        if res.elastic:
+        if res.elastic and self._join is not None:
+            # The admission handshake already attached this process to the
+            # live generation; adopt the record's resume truth (state,
+            # step clock, re-split lineage) instead of minting anything.
+            self.elastic = self._join.coordinator
+            self._adopt_join_resume(self._join.record)
+        elif res.elastic:
             import uuid
 
             from tpu_dp.resilience import ElasticCoordinator
@@ -362,6 +402,7 @@ class Trainer:
                 poll_every_steps=res.elastic_poll_every_steps,
                 coordinator_host=res.elastic_coordinator_host,
                 min_world=res.elastic_min_world,
+                max_world=res.elastic_max_world,
             )
         self._metrics_file = None  # lazily opened by _log_metrics (rank 0)
         self._hb_write_failed = False  # one-shot heartbeat-failure warning
@@ -384,9 +425,12 @@ class Trainer:
             from tpu_dp.obs import HealthMonitor, HeartbeatWriter, SpanRecorder
 
             self.spans = SpanRecorder(capacity=cfg.obs.span_capacity)
-            if cfg.obs.heartbeat_every_steps > 0:
+            if cfg.obs.heartbeat_every_steps > 0 and self._join is None:
                 # Every rank appends to its own heartbeat file — per-rank
-                # host IO is the protocol, not a rank gate.
+                # host IO is the protocol, not a rank gate. A JOINER never
+                # writes into the launch obs root: its dense rank's
+                # filename there belongs to a me-epoch-0 seat it never
+                # held (`_complete_join` homes it into obs/me<E>/).
                 self.heartbeat = HeartbeatWriter(
                     self.obs_dir, rank=self.ctx.process_index,
                     every_steps=cfg.obs.heartbeat_every_steps,
@@ -437,6 +481,11 @@ class Trainer:
                 rank=self.stable_rank, dump_dir=self.obs_dir,
                 capacity=cfg.obs.flightrec_capacity,
                 fresh=True,  # a new Trainer is a new run's black box
+                # A rejoined incarnation's dump must coexist with its
+                # predecessor's departure dump (same stable rank): the
+                # membership epoch it was admitted at tags the filename.
+                tag=(f"me{self._join.record.epoch:04d}"
+                     if self._join is not None else ""),
                 run={
                     "model": cfg.model.name,
                     "world": self.ctx.process_count,
@@ -444,6 +493,7 @@ class Trainer:
                     "global_batch": self.global_batch_size,
                     "elastic": bool(cfg.resilience.elastic),
                     "guard": self.guard_enabled,
+                    "joined": self._join is not None,
                 },
             )
         self._prom_failed = False  # one-shot prom-write failure warning
@@ -476,8 +526,81 @@ class Trainer:
         # registers here instead of splicing into the hot loop.
         self._build_hooks()
 
-        if cfg.train.verify_fingerprint:
+        if self._join is not None:
+            # The joiner's half of the regroup epilogue — observers homed
+            # into the me-epoch, then the SAME verify + barrier sequence
+            # the incumbents run at the tail of `_execute_regroup`, so the
+            # grown mesh's first collectives are exactly matched.
+            self._complete_join(self._join.record)
+        elif cfg.train.verify_fingerprint:
             self._verify_step_fingerprint()
+
+    def _adopt_join_resume(self, record) -> None:
+        """Install the admitted membership record's resume truth.
+
+        The joiner's state comes from the grow quiesce's final snapshot
+        (the record's ``resume.snapshot_dir``) through the resharding
+        `load_checkpoint` path — NEVER from this process's own disk,
+        which belongs to a retired incarnation and may be arbitrarily
+        stale. Step clock, consumption lineage, and the re-split tail all
+        follow the record, exactly like a surviving incumbent's.
+        """
+        resume = dict(record.resume or {})
+        snap = resume.get("snapshot_dir")
+        if snap:
+            self.state, _ = ckpt_lib.load_checkpoint(Path(snap), self.state)
+            self.state = self._place_state(self.state)
+        else:
+            # Nothing on disk at the agreed resume point: the run itself
+            # restarted from scratch at this epoch; the joiner does too.
+            log0("elastic join: admitted record carries no snapshot — "
+                 "starting from init like the incumbents")
+        self._host_step = int(resume.get("global_step", 0))
+        self._quant_pub_step = self._host_step
+        epoch = int(resume.get("epoch", 0))
+        lineage = resume.get("lineage") or []
+        if lineage:
+            has_tail = self._set_elastic_tail(epoch, lineage)
+            self.start_epoch, self.start_step = (
+                (epoch, 0) if has_tail else (epoch + 1, 0)
+            )
+        else:
+            self.start_epoch = epoch
+            self.start_step = int(resume.get("steps_done", 0))
+        log0("elastic join: adopted resume — epoch %d step %d (global "
+             "step %d, membership epoch %d, world %d)",
+             self.start_epoch, self.start_step, self._host_step,
+             record.epoch, record.world)
+
+    def _complete_join(self, record) -> None:
+        """Mirror of `_execute_regroup`'s epilogue on the joiner side."""
+        from tpu_dp.obs import flightrec
+
+        # The joiner's own act, in ITS ring — "elastic_join", the grow
+        # twin of the leaver's "elastic_departure"; the membership record
+        # tells the corresponding "rank_joined" (like "eviction"), so the
+        # timeline never double-tells one admission under one kind.
+        flightrec.record("elastic_join", step=self._host_step,
+                         sid=self.stable_rank,
+                         membership_epoch=record.epoch, world=record.world,
+                         rank=self.ctx.process_index)
+        self._rebuild_observers(record)
+        if self._guard_hook is not None:
+            # Fresh audit baseline at the adopted step: nothing older
+            # than the admission can be this incarnation's clean point.
+            self._guard_hook.on_regroup()
+        if self.cfg.resilience.elastic_verify_fingerprint:
+            self._verify_step_fingerprint(
+                tag=f"train_step@me{record.epoch}w{record.world}"
+            )
+        dist.membership_barrier(
+            "regroup_ready", record.epoch,
+            timeout_s=self.cfg.resilience.regroup_timeout_s,
+        )
+        log0("elastic join: membership epoch %d live — joined at world "
+             "%d as dense rank %d (stable id %d)",
+             record.epoch, record.world, self.ctx.process_index,
+             self.stable_rank)
 
     def _with_residuals(self, state):
         """Attach zero-initialized error-feedback residuals when the int8
@@ -890,7 +1013,13 @@ class Trainer:
                 log0("obs: measured step cost %.3g FLOPs/step/chip "
                      "(%s, check=%s)", resolved, source, check)
         if cost is not None:
-            for tag in ("multi_step", f"multi_step[w{self.steps_per_call}]"):
+            # The world-keyed alias records which mesh shape this cost
+            # belongs to — after an elastic regroup the registry carries
+            # one tag per world the run passed through, so post-hoc MFU
+            # questions ("was the shrunk mesh efficient?") resolve per
+            # shape instead of against whatever topology ended the run.
+            for tag in ("multi_step", f"multi_step[w{self.steps_per_call}]",
+                        f"train_step@w{dist.data_axis_size(self.mesh)}"):
                 costs.registry.alias(tag, "train_step")
             from tpu_dp.obs.counters import counters as _c
 
@@ -943,7 +1072,12 @@ class Trainer:
             )
             return train, test
 
-        if self.ctx.process_count == 1:
+        if self.ctx.process_count == 1 or self._join is not None:
+            # A joiner must not run the materialization barrier: the
+            # incumbents are mid-regroup (they will next meet it at the
+            # DP304 verify / regroup_ready barrier, not here), and the
+            # dataset already materialized at the original launch — the
+            # shared filesystem elastic requires makes it readable now.
             self.train_ds, self.test_ds = _load()
             return
         from jax.experimental import multihost_utils
@@ -1569,13 +1703,14 @@ class Trainer:
                 f"(see its log); refusing to regroup the same world"
             )
 
-        if plan.flavor == "graceful":
+        if plan.flavor in ("graceful", "grow"):
             # The final snapshot at the agreed step — the regroup's resume
-            # point, so the world change replays and drops nothing. Joined
-            # (not just dispatched) before the barrier ack, like the
-            # preemption contract's. A failure here (a peer died between
-            # the plan and the stop step, poisoning the device state this
-            # fetch materializes) must not kill the regroup: the leader's
+            # point, so the world change replays and drops nothing (for a
+            # grow it is also the JOINER's state source). Joined (not just
+            # dispatched) before the barrier ack, like the preemption
+            # contract's. A failure here (a peer died between the plan and
+            # the stop step, poisoning the device state this fetch
+            # materializes) must not kill the regroup: the leader's
             # pre-publish validation sees the missing snapshot and falls
             # back to a rollback resume.
             try:
@@ -1613,6 +1748,27 @@ class Trainer:
                 epoch, self._host_step, leaving=False, flavor="rollback",
                 window=self.steps_per_call,
             )
+        elif self._quiesce_plan.flavor == "grow":
+            # A member died while a GROW plan was already adopted. The
+            # plan is immutable for this epoch (exclusive-create) and its
+            # survivor set — every incumbent plus the joiner — now
+            # contains a dead rank, so neither the grown bootstrap nor a
+            # rollback re-form of that exact set can ever rendezvous
+            # (and the bootstrap failure mode is a LOG(FATAL), not an
+            # error). The explicit answer (docs/RESILIENCE.md failure
+            # matrix): fail fast and typed; the supervisor's full-world
+            # restart — which resumes from the newest snapshot at any
+            # world — is the recovery.
+            from tpu_dp.resilience import ElasticError
+
+            plan_epoch = self._quiesce_plan.epoch
+            self._quiesce_plan = None
+            raise ElasticError(
+                f"member failure while grow plan e{plan_epoch} was in "
+                f"flight ({err}); the planned membership (incumbents + "
+                f"joiner) is unsatisfiable with a dead member — restart "
+                f"the world"
+            ) from err
         elif self._quiesce_plan.flavor == "graceful":
             # A graceful plan was adopted, then the mesh died under it
             # (e.g. the announced leaver was hard-killed before the stop
@@ -1808,21 +1964,25 @@ class Trainer:
         return position
 
     def _execute_regroup(self, sig: _RegroupSignal) -> tuple[int, int]:
-        """Shrink the mesh to the survivors and continue the run.
+        """Re-form the mesh — shrink to the survivors or GROW to admit a
+        joiner — and continue the run.
 
         The tentpole sequence (docs/RESILIENCE.md "Elastic world size"):
         publish/adopt the new membership record → abandon the old
-        distributed context and re-`initialize` at world N-1 → rebuild
-        pipelines and compiled programs against the shrunk mesh → reload
-        the agreed state through the resharding `load_checkpoint` →
-        re-split the interrupted epoch over the survivors → re-verify the
-        DP304 collective fingerprint — all before the first post-regroup
-        step. Returns the ``(epoch, start_step)`` to continue from.
+        distributed context and re-`initialize` at the new world →
+        rebuild pipelines and compiled programs against the re-formed
+        mesh → reload the agreed state through the resharding
+        `load_checkpoint` → re-split the interrupted epoch over the new
+        world → re-verify the DP304 collective fingerprint — all before
+        the first post-regroup step. Returns the ``(epoch, start_step)``
+        to continue from. A grow whose joiner dies mid-handshake falls
+        back to re-forming at world N from the same snapshot (bounded by
+        the bootstrap timeout; no work lost, no rollback).
         """
         t0 = time.perf_counter()
         plan = sig.plan
         cfg = self.cfg
-        if plan.flavor == "graceful":
+        if plan.flavor in ("graceful", "grow"):
             snap_dir = Path(self.snapshot_dir) / f"step_{self._host_step:010d}"
             resume = {
                 "epoch": sig.epoch,
@@ -1833,7 +1993,7 @@ class Trainer:
                 "global_step": self._host_step,
                 "snapshot_dir": str(snap_dir),
             }
-            if (self.elastic.sid == min(plan.survivors)
+            if (self.elastic.sid == min(plan.incumbents or plan.survivors)
                     and not (snap_dir / "state.msgpack").exists()):
                 # The final snapshot never landed (the writer died inside
                 # its grace window): the new leader validates BEFORE
@@ -1845,6 +2005,57 @@ class Trainer:
         else:
             resume = self._rollback_resume()
         record = self.elastic.establish(plan, resume)
+        if record.joined:
+            # The grow gate: commit to the grown bootstrap only for
+            # joiners that are demonstrably alive NOW. A coordination
+            # connect with an absent party is not a catchable failure —
+            # the client LOG(FATAL)s on rendezvous timeout — so "is the
+            # joiner coming?" is answered on the ledger first: each
+            # admitted joiner signals join_ready immediately before its
+            # own connect; one that never signals within the bounded wait
+            # is presumed dead mid-handshake and the incumbents re-form
+            # at world N from the same snapshot (no wedge, no rollback).
+            # ONE decider: the incumbent leader runs the wait and
+            # publishes the verdict; everyone else follows the ledger —
+            # per-incumbent timers would split the camps on a joiner that
+            # signals inside the timers' skew window.
+            from tpu_dp.resilience import ElasticError
+
+            joined_sids = [int(j["sid"]) for j in record.joined]
+            incumbents = [m for m in record.members
+                          if m not in joined_sids]
+            if self.elastic.sid == min(incumbents):
+                missing = self.elastic.ledger.await_join_ready(
+                    record.epoch, joined_sids,
+                    timeout_s=cfg.resilience.regroup_timeout_s,
+                )
+                self.elastic.ledger.publish_grow_verdict(
+                    record.epoch, commit=not missing,
+                    reason=("" if not missing else
+                            f"no join_ready from {missing}"),
+                )
+                commit = not missing
+            else:
+                verdict = self.elastic.ledger.await_grow_verdict(
+                    record.epoch,
+                    timeout_s=2 * cfg.resilience.regroup_timeout_s,
+                )
+                if verdict is None:
+                    raise ElasticError(
+                        f"grow e{record.epoch}: no verdict from the "
+                        f"incumbent leader within "
+                        f"{2 * cfg.resilience.regroup_timeout_s:.0f}s "
+                        f"(leader died mid-grow)"
+                    )
+                commit = bool(verdict.get("commit"))
+            if not commit:
+                log0("elastic: admitted joiner(s) never signalled ready "
+                     "within %.0fs — aborting the grow, re-forming at "
+                     "world %d", cfg.resilience.regroup_timeout_s,
+                     record.world - len(record.joined))
+                record = self.elastic.establish_fallback(
+                    record, reason="join handshake timeout (grow aborted)"
+                )
         resume = record.resume  # the leader's payload is canonical
         old_world = self.ctx.process_count
         old_rank = self.ctx.process_index
@@ -1860,7 +2071,26 @@ class Trainer:
         self.state = None
         if self.heartbeat is not None:
             self.heartbeat.close()
-        self.ctx = self.elastic.reinitialize(record)
+        try:
+            self.ctx = self.elastic.reinitialize(record)
+        except Exception:
+            if not record.joined:
+                raise
+            # The admitted joiner never completed the handshake (crashed
+            # between its request and the coordination connect): every
+            # incumbent's bootstrap timed out symmetrically. Re-form at
+            # world N from the SAME resume payload — the grow quiesce's
+            # snapshot — so the aborted grow costs the bounded timeout
+            # and nothing else (no wedge, no rollback).
+            log0("elastic: grow bootstrap at world %d failed — joiner "
+                 "presumed dead mid-handshake; re-forming at world %d",
+                 record.world, record.world - len(record.joined),
+                 exc_info=True)
+            record = self.elastic.establish_fallback(
+                record, reason="join handshake timeout (grow aborted)"
+            )
+            resume = record.resume
+            self.ctx = self.elastic.reinitialize(record)
         self.mesh = dist.data_mesh(
             num_devices=(
                 self._devices_per_process * self.ctx.process_count
@@ -1890,6 +2120,10 @@ class Trainer:
         # The codec-stats publish marker rewinds with the step clock (a
         # rollback-flavor regroup replays below the old high-water mark).
         self._quant_pub_step = self._host_step
+        # Program costs are per-topology (per-chip batch changed with the
+        # world): re-register so post-regroup MFU/goodput gauges divide by
+        # THIS mesh's cost, and the world-keyed alias tags the new shape.
+        self._register_program_costs()
 
         # Re-split the interrupted epoch over the survivors: every
         # remaining sample visited exactly once (graceful), or the
@@ -1915,12 +2149,14 @@ class Trainer:
         if self._guard_hook is not None:
             self._guard_hook.on_regroup()
 
-        # DP304 on the shrunk mesh, before the first post-regroup step: a
-        # survivor about to run a different collective schedule fails here,
-        # not as a deadlock at step one.
+        # DP304 on the re-formed mesh, before the first post-regroup step:
+        # a member about to run a different collective schedule fails
+        # here, not as a deadlock at step one. The tag is keyed by BOTH
+        # the membership epoch and the new world size, so the fingerprint
+        # artifact names which mesh shape each verification covered.
         if cfg.resilience.elastic_verify_fingerprint:
             self._verify_step_fingerprint(
-                tag=f"train_step@me{record.epoch}"
+                tag=f"train_step@me{record.epoch}w{record.world}"
             )
         dist.membership_barrier(
             "regroup_ready", record.epoch,
@@ -1928,8 +2164,12 @@ class Trainer:
         )
 
         dt = time.perf_counter() - t0
+        joined = [int(j["sid"]) for j in record.joined]
         _obs_counters.inc("elastic.regroups")
-        _obs_counters.inc("elastic.lost_ranks", old_world - record.world)
+        _obs_counters.inc("elastic.lost_ranks",
+                          max(0, old_world - record.world))
+        _obs_counters.inc("elastic.joined_ranks",
+                          max(0, record.world - old_world))
         _obs_counters.inc("elastic.regroup_s", dt)
         from tpu_dp.obs import flightrec
 
@@ -1938,8 +2178,17 @@ class Trainer:
             membership_epoch=record.epoch, flavor=plan.flavor,
             world=record.world,
             departed=[d.get("sid") for d in record.departed],
+            joined=joined,
             regroup_s=round(dt, 3),
         )
+        if joined:
+            # The grow gets its own marker next to the generic regroup:
+            # "capacity came back" is the signal operators grep for.
+            flightrec.record(
+                "elastic_grow", step=self._host_step,
+                membership_epoch=record.epoch, world=record.world,
+                joined=joined,
+            )
         if self.spans is not None:
             self.spans.record_window(
                 self._host_step, 1, {"elastic_regroup": dt * 1e3},
@@ -1951,12 +2200,20 @@ class Trainer:
             "flavor": plan.flavor,
             "world": record.world,
             "departed": [d["sid"] for d in record.departed],
+            "joined": joined,
             "resume_epoch": position[0],
             "resume_step": position[1] or (
                 self._elastic_tail.base if self._elastic_tail else 0
             ),
             "regroup_s": round(dt, 3),
         })
+        if joined:
+            self._log_metrics({
+                "event": "elastic_grow",
+                "membership_epoch": record.epoch,
+                "world": record.world,
+                "joined": joined,
+            })
         log0(
             "elastic: membership epoch %d live — world %d→%d (rank %d→%d), "
             "%s resume at epoch %d step %d, regroup took %.2fs",
@@ -2012,6 +2269,12 @@ class Trainer:
                 min_step_ms=self.cfg.obs.min_step_ms,
                 on_flag=self.cfg.obs.on_straggler,
             )
+            # A freshly admitted joiner has no heartbeat history; this
+            # monitor is constructed AT the admission, so its own startup
+            # grace (`HealthMonitor._start`) is exactly the joiner's
+            # admission grace — no per-rank bookkeeping needed here.
+            # `HealthMonitor.admit` exists for monitors that OUTLIVE an
+            # admission (out-of-band watchers over a growing world).
         if self._metrics_file is not None and self.ctx.process_index != 0:  # dplint: allow(DP101) host-only IO
             # A demoted rank 0 keeps the sink closed; the new rank 0's
             # `_log_metrics` appends to the same shared-filesystem file.
@@ -2352,3 +2615,50 @@ class Trainer:
             print0("Accuracy of the network on the %d test images: %d %%"
                    % (len(self.test_ds), int(100 * eval_stats["accuracy"])))
         return result
+
+
+def run_elastic(cfg: Config) -> tuple[Trainer, dict[str, Any]]:
+    """Drive `Trainer.fit` with the ``relaunch:`` fault's in-process rejoin.
+
+    The deterministic twin of "the preempted rank comes back"
+    (docs/RESILIENCE.md "Fault-injection spec"): a fired
+    ``relaunch:step=K,rank=R`` departs exactly like ``leave:`` — the full
+    single-rank elastic-departure protocol, survivors shrink to world N−1
+    — but instead of surfacing the `PreemptedError` this driver builds a
+    JOIN-mode Trainer in the same OS process (ledger discovery, fenced
+    join request, admission, state restore from the agreed snapshot) and
+    keeps training to completion at the regrown world. Every other
+    `PreemptedError` propagates unchanged (train.py's exit-143 contract),
+    as does a departure on a non-elastic run. One rejoin per call: a
+    REAL preemption of the rejoined incarnation exits 143 like any other.
+    """
+    from tpu_dp.resilience import PreemptedError
+
+    tr = Trainer(cfg)
+    rejoined = False
+    while True:
+        try:
+            return tr, tr.fit()
+        except PreemptedError:
+            fault = tr.fault
+            if rejoined or not (
+                fault is not None and fault.plan.kind == "relaunch"
+                and fault.fired
+            ):
+                raise
+            rejoined = True
+            log0("relaunch fault: departed at global step %d — rejoining "
+                 "the run in-process", tr._host_step)
+            import copy
+
+            cfg2 = copy.deepcopy(cfg)
+            cfg2.resilience.fault = ""
+            cfg2.resilience.elastic_join = "always"
+            cfg2.train.resume = False
+            tr = Trainer(cfg2)
+            if tr.fault is not None and tr.fault.plan.kind == "relaunch":
+                # A TPU_DP_FAULT env spec survives into the rejoined
+                # incarnation (cfg2 cleared only the config field); the
+                # plan already fired once this process — mark it spent so
+                # the rejoined rank does not immediately leave again.
+                tr.fault.fired = True

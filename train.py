@@ -28,15 +28,23 @@ import sys
 
 from tpu_dp.config import parse_cli
 from tpu_dp.resilience import DivergedError, PreemptedError
-from tpu_dp.train.trainer import Trainer
+from tpu_dp.train.trainer import Trainer, run_elastic
 from tpu_dp.utils import print0
 
 
 def main(argv=None) -> int:
     cfg = parse_cli(sys.argv[1:] if argv is None else argv)
-    trainer = Trainer(cfg)
     try:
-        result = trainer.fit()
+        if cfg.resilience.elastic:
+            # The relaunch-aware driver: identical to Trainer(cfg).fit()
+            # except that a fired `relaunch:` fault rejoins the run
+            # in-process instead of exiting 143 (docs/RESILIENCE.md
+            # "Fault-injection spec"); it also lets a relaunched process
+            # JOIN a live run via resilience.elastic_join.
+            trainer, result = run_elastic(cfg)
+        else:
+            trainer = Trainer(cfg)
+            result = trainer.fit()
     except PreemptedError as e:
         # Clean preemption: the final snapshot is committed; exit with the
         # conventional terminated-by-SIGTERM status so supervisors restart
@@ -97,6 +105,7 @@ def main(argv=None) -> int:
             "members": list(rec.members),
             "regroups": int(obs_counters.get("elastic.regroups")),
             "lost_ranks": int(obs_counters.get("elastic.lost_ranks")),
+            "joined_ranks": int(obs_counters.get("elastic.joined_ranks")),
             "regroup_s": round(obs_counters.get("elastic.regroup_s"), 3),
         }
     print0(json.dumps(summary))
