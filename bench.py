@@ -567,6 +567,68 @@ def measure_point(cfg: dict) -> dict:
             quant_rec["clip_blocks"] = quant_clip
             quant_rec["stats_steps"] = quant_steps
 
+    comm_rec = None
+    if cfg.get("comm_profile"):
+        # Comm/compute attribution block (tpu_dp.obs.commprof,
+        # docs/OBSERVABILITY.md "Comm/compute attribution"): capture one
+        # profiled window of the already-compiled program, parse the
+        # xplane trace, and attach the comm_ms / exposed_comm_ms /
+        # overlap_frac headline (reconciled against the program's own
+        # static collective schedule) so `obsctl diff` can gate a live
+        # run's comm attribution against this BENCH record.
+        import tempfile
+
+        from tpu_dp.obs import chips as chips_mod
+        from tpu_dp.obs import commprof as commprof_mod
+        from tpu_dp.obs import xplane as xplane_mod
+
+        trace_dir = tempfile.mkdtemp(prefix="tpu_dp_bench_comm_")
+        try:
+            if window > 1:
+                with jax.profiler.trace(trace_dir):
+                    state, m = loop_exe(state, pool)
+                    float(m["loss"][-1])
+                comm_exe, comm_steps = loop_exe, window
+            else:
+                with jax.profiler.trace(trace_dir):
+                    state, m = step_exe(state, batches[0])
+                    float(m["loss"])
+                comm_exe, comm_steps = step_exe, 1
+            summary = xplane_mod.summarize_robust(trace_dir)
+            expected = commprof_mod.expected_from_hlo_text(
+                comm_exe.as_text())
+            wire_rep = None
+            if collective_dtype or update_sharding == "sharded":
+                from tpu_dp.parallel import quant as quant_mod2
+
+                wire_rep = quant_mod2.wire_report(state.params, n_chips,
+                                                  quant_block)
+            rep = commprof_mod.breakdown(
+                summary, steps=comm_steps,
+                devices=n_chips if summary.get("source") == "host" else 1,
+                expected_total={k: v * comm_steps
+                                for k, v in expected["counts"].items()},
+                collectives=expected["collectives"],
+                world=n_chips,
+                wire_report=wire_rep,
+                wire_dtype=collective_dtype,
+                ici_gbs=chips_mod.ici_gbs(jax.devices()[0].device_kind),
+            )
+            comm_rec = {
+                "comm_ms": rep["comm_ms"],
+                "exposed_comm_ms": rep["exposed_comm_ms"],
+                "overlap_frac": rep["overlap_frac"],
+                "compute_ms": rep["compute_ms"],
+                "reconciled": (rep.get("reconciliation") or {}).get("ok"),
+                "by_kind": {k: v["per_step"]
+                            for k, v in rep["by_kind"].items()},
+                "steps": comm_steps,
+                "source": rep["source"],
+            }
+        except Exception as e:  # never fail a measurement over a report stat
+            print(f"bench: comm profile failed ({e!r})", file=sys.stderr)
+            comm_rec = {"error": str(e)[:300]}
+
     images_per_sec = n_steps_timed * global_batch / elapsed
     per_chip_ips = images_per_sec / n_chips
     device_kind = jax.devices()[0].device_kind
@@ -617,6 +679,8 @@ def measure_point(cfg: dict) -> dict:
         }
         if latency_rec is not None:
             rec["latency"] = latency_rec
+        if comm_rec is not None:
+            rec["comm"] = comm_rec
         if quant_rec is not None:
             rec["quant"] = quant_rec
         if snapshot_rec is not None:
@@ -776,6 +840,13 @@ def main() -> None:
     ap.add_argument("--quant-block-size", type=int, default=256,
                     help="scaling-block length of the int8 wire codec "
                          "(train.quant_block_size)")
+    ap.add_argument("--comm-profile", action="store_true",
+                    help="capture one jax.profiler window of the measured "
+                         "program, parse it (tpu_dp.obs.xplane) and attach "
+                         "a 'comm' block — comm_ms / exposed_comm_ms / "
+                         "overlap_frac, reconciled against the program's "
+                         "static collective schedule — gateable by "
+                         "`obsctl diff` like mfu")
     ap.add_argument("--latency-steps", type=int, default=20,
                     help="fenced per-step latency sample size for the "
                          "p50/p95/p99 'latency' block (tpu_dp.obs.spans; "
@@ -877,6 +948,7 @@ def main() -> None:
             "snapshot_every": args.snapshot_every,
             "guard_overhead_steps": args.guard_overhead,
             "latency_steps": args.latency_steps,
+            "comm_profile": args.comm_profile,
             "update_sharding": args.update_sharding,
             "collective_dtype": args.collective_dtype,
             "quant_block_size": args.quant_block_size,

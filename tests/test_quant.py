@@ -820,5 +820,8 @@ def test_diff_verdict_skips_quant_for_unquantized_runs():
     assert not v["regressed"]
     skipped = {c["signal"] for c in v["checks"]
                if c["verdict"] == "skipped"}
+    # The comm-attribution signals follow the same contract: a run that
+    # never profiled a comm window is skipped, never compared as 0.
     assert skipped == {"quant_overflow_per_step",
-                       "quant_clip_blocks_per_step"}
+                       "quant_clip_blocks_per_step",
+                       "comm_ms", "exposed_comm_ms", "overlap_frac"}

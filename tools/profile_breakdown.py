@@ -3,9 +3,12 @@
 
 Produces the numbers behind docs/DESIGN.md "Where the other half of peak
 goes": captures a `jax.profiler` trace of a `make_multi_step` window
-(identical config to bench.py's headline point), parses the xplane proto,
-and aggregates device time by HLO category plus a per-op efficiency table
-(achieved TFLOP/s and GB/s vs the chip's peaks).
+(identical config to bench.py's headline point), parses the xplane proto
+through `tpu_dp.obs.xplane` (the reusable library this tool is now a thin
+CLI over — the in-run comm attribution layer `tpu_dp.obs.commprof` reads
+traces through the same code path), and aggregates device time by HLO
+category plus a per-op efficiency table (achieved TFLOP/s and GB/s vs the
+chip's peaks, from the unified `tpu_dp.obs.chips` registry).
 
     python tools/profile_breakdown.py                  # b2048, w30 (headline)
     python tools/profile_breakdown.py --per-chip-batch 1024 --window 30
@@ -14,33 +17,35 @@ and aggregates device time by HLO category plus a per-op efficiency table
 
 Parsing notes (this environment): the Perfetto trace.json.gz export carries
 host lanes only on this relay transport — the device lanes live in the
-xplane.pb, read here via tensorflow's bundled xplane proto. The protobuf
-runtime rejects that generated module under the C++ backend, so this tool
-re-execs itself with PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python when
-needed. Tracing inflates wall time (trace upload over the relay); the
-*within-trace* device timestamps remain accurate, which is what's reported.
+xplane.pb. The protobuf runtime may reject TF's generated xplane module
+under the C++ backend, so this tool re-execs itself with
+PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python when needed (the documented
+helper `tpu_dp.obs.xplane.reexec_with_python_protobuf`). Tracing inflates
+wall time (trace upload over the relay); the *within-trace* device
+timestamps remain accurate, which is what's reported. CPU-backend traces
+have no device plane — inspect those with `python -m tpu_dp.obs.xplane`.
 """
 
 from __future__ import annotations
 
 import argparse
-import glob
-import os
 import sys
 import tempfile
-from collections import defaultdict
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
 
-V5E_PEAK_TFLOPS = 197.0
-V5E_PEAK_HBM_GBS = 819.0
-
 # One source of truth for model -> num_classes: bench.py's MODEL_SPECS
 # (BASELINE.json config 3 runs ResNet-50 on CIFAR-100).
 from bench import MODEL_SPECS  # noqa: E402  (repo root on sys.path above)
+from tpu_dp.obs import chips  # noqa: E402  (unified chip-peak registry)
 
 MODEL_CLASSES = {name: spec[1] for name, spec in MODEL_SPECS.items()}
+
+#: The tool's historical target chip (the relay exposes one v5e); the
+#: drift-prone local V5E_PEAK_* constants are gone — docs/DESIGN.md
+#: numbers now cite the same registry MFU divides by.
+_V5E = chips.chip_spec("v5e")
 
 
 def capture(trace_dir: str, per_chip: int, window: int, model_name: str,
@@ -82,46 +87,28 @@ def capture(trace_dir: str, per_chip: int, window: int, model_name: str,
 
 
 def report(trace_dir: str, top: int) -> None:
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    """Parse + print the device-plane breakdown (output format unchanged
+    from the pre-library versions; tests/test_profile_breakdown.py pins
+    it). The heavy lifting — file discovery, proto parse, the %while
+    wrapper/window split, per-op aggregation — is `tpu_dp.obs.xplane`'s."""
+    from tpu_dp.obs import xplane
 
-    paths = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
-    if not paths:
+    path = xplane.find_xplane(trace_dir)
+    if path is None:
         sys.exit(f"no xplane.pb under {trace_dir}")
-    xs = xplane_pb2.XSpace()
-    xs.ParseFromString(open(sorted(paths)[-1], "rb").read())
+    xs = xplane.load_xspace(path)
     devs = [p for p in xs.planes if p.name.startswith("/device:")
             and any(line.events for line in p.lines)]
     if not devs:
         sys.exit("no device plane with events (tracing unsupported here?)")
     dev = devs[0]
-    md, sm = dev.event_metadata, dev.stat_metadata
-    sname = {k: v.name for k, v in sm.items()}
-    op_lines = [line for line in dev.lines if line.name == "XLA Ops"]
-    if not op_lines:
+    if not any(line.name == "XLA Ops" for line in dev.lines):
         sys.exit(f"device plane {dev.name} has no 'XLA Ops' line "
                  f"(lines: {[line.name for line in dev.lines]})")
-    ops = op_lines[0]
+    s = xplane.device_plane_summary(dev)
 
-    by_cat = defaultdict(float)
-    per_op = defaultdict(lambda: [0.0, 0, 0, 0])  # dur_s, flops, bytes, n
-    window_s = 0.0
-    for e in ops.events:
-        m = md[e.metadata_id]
-        if m.name.startswith("%while"):  # scan wrapper spans the whole window
-            window_s += e.duration_ps / 1e12
-            continue
-        st = {sname[s.metadata_id]: s for s in m.stats}
-        cat = st["hlo_category"].str_value if "hlo_category" in st else "?"
-        by_cat[cat] += e.duration_ps / 1e12
-        fl = (st["model_flops"].int64_value if "model_flops" in st
-              else st["flops"].int64_value if "flops" in st else 0)
-        by = st["bytes_accessed"].int64_value if "bytes_accessed" in st else 0
-        rec = per_op[m.name.split(" = ")[0]]
-        rec[0] += e.duration_ps / 1e12
-        rec[1] += fl
-        rec[2] += by
-        rec[3] += 1
-
+    by_cat = s["by_category"]
+    window_s = s["window_s"]
     total = sum(by_cat.values())
     if total <= 0:
         sys.exit("no non-wrapper op events in the trace — was a step "
@@ -132,17 +119,17 @@ def report(trace_dir: str, top: int) -> None:
     for k, v in sorted(by_cat.items(), key=lambda kv: -kv[1]):
         print(f"{v*1e3:9.1f} ms {100*v/total:6.1f}%  {k}")
 
-    tot_f = sum(r[1] for r in per_op.values())
+    tot_f = sum(r["flops"] for r in s["ops"])
     print(f"\nmodel FLOPs in window: {tot_f/1e12:.2f} T "
           f"(avg {tot_f/total/1e12:.1f} TF/s, "
-          f"{100*tot_f/total/(V5E_PEAK_TFLOPS*1e12):.0f}% of v5e bf16 peak)")
+          f"{100*tot_f/total/_V5E.peak_flops:.0f}% of v5e bf16 peak)")
     print(f"\n-- top {top} ops by device time --")
     print(f"{'ms':>8} {'TF/s':>6} {'%peak':>6} {'GB/s':>7} {'n':>4}  op")
-    for base, (d, f, b, n) in sorted(per_op.items(),
-                                     key=lambda kv: -kv[1][0])[:top]:
+    for r in s["ops"][:top]:
+        d, f, b, n = r["dur_s"], r["flops"], r["bytes"], r["count"]
         print(f"{d*1e3:8.1f} {f/d/1e12:6.1f} "
-              f"{100*f/d/(V5E_PEAK_TFLOPS*1e12):6.1f} {b/d/1e9:7.0f} "
-              f"{n:4d}  {base}")
+              f"{100*f/d/_V5E.peak_flops:6.1f} {b/d/1e9:7.0f} "
+              f"{n:4d}  {r['name']}")
 
 
 def main() -> None:
@@ -166,10 +153,11 @@ def main() -> None:
     ap.add_argument("--top", type=int, default=25)
     args = ap.parse_args()
 
-    # The TF-bundled xplane_pb2 needs the pure-python protobuf runtime.
-    if os.environ.get("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION") != "python":
-        os.environ["PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION"] = "python"
-        os.execv(sys.executable, [sys.executable] + sys.argv)
+    # The TF-bundled xplane_pb2 may need the pure-python protobuf runtime;
+    # the re-exec hack lives in the library now (one documented helper).
+    from tpu_dp.obs.xplane import reexec_with_python_protobuf
+
+    reexec_with_python_protobuf()
 
     trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="tpu_dp_trace_")
     if not args.report_only:

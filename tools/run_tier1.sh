@@ -77,6 +77,21 @@
 #                                    # and merged trace, then the -m obs
 #                                    # tests (which now cover flightrec /
 #                                    # costs / promfile / obsctl).
+#   tools/run_tier1.sh --commprof   # comm-attribution lane: a profiled
+#                                    # 10-step sharded-update smoke on the
+#                                    # 8-device CPU mesh with an in-run
+#                                    # capture window ([4,6)); exit-coded
+#                                    # checks that the parsed breakdown's
+#                                    # collective counts reconcile exactly
+#                                    # with the program's fingerprint
+#                                    # schedule and the wire bytes with
+#                                    # quant.wire_report; archives
+#                                    # artifacts/comm_report.json; then
+#                                    # `obsctl watch --replay` must exit 0
+#                                    # on the clean run and 1 on a
+#                                    # tampered stream (the live-alert
+#                                    # gate proof), then the -m commprof
+#                                    # tests.
 #   tools/run_tier1.sh --quant      # quantized-collectives lane: an int8
 #                                    # BENCH point on the 8-device CPU
 #                                    # mesh with exit-coded quant-block
@@ -316,6 +331,81 @@ PY
     rm -rf "$SMOKE"
     echo "obsctl lane: artifacts/obsctl_report.json + obsctl_timeline*.json + obsctl_trace.json"
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--commprof" ]; then
+    # Comm-attribution lane (docs/OBSERVABILITY.md "Comm/compute
+    # attribution"): the smoke run captures an in-run profile window on
+    # the sharded update and the checks below are the acceptance bar —
+    # exact trace-vs-fingerprint collective reconciliation, wire-byte
+    # agreement with the codec's own accounting, and the watch gate
+    # tripping on a tampered stream while passing the clean one.
+    mkdir -p artifacts
+    SMOKE=$(mktemp -d /tmp/tpu_dp_commprof_smoke.XXXXXX) || exit 1
+    env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python train.py \
+        --data.dataset=synthetic --data.synthetic_train_size=80 \
+        --data.synthetic_test_size=16 --data.batch_size=8 \
+        --data.device_resident=off \
+        --train.epochs=1 --train.log_every=5 --train.eval_at_end=false \
+        --train.steps_per_call=1 --train.obs=full \
+        --train.update_sharding=sharded \
+        --train.ckpt_dir="$SMOKE/ck" \
+        --obs.comm_profile_steps=4:6 || exit $?
+    env JAX_PLATFORMS=cpu python -m tpu_dp.obs diff "$SMOKE/ck" \
+        --write-baseline "$SMOKE/base.json" || exit $?
+    # Per-record goodput rules would trip on the compile steps of any
+    # short smoke (data_wait includes the first window's compile), so
+    # the clean gate watches the comm + liveness signals.
+    env JAX_PLATFORMS=cpu python -m tpu_dp.obs watch "$SMOKE/ck" --replay \
+        --baseline "$SMOKE/base.json" \
+        --rule 'exposed_comm_ms>1.5*baseline' \
+        --rule 'straggler_ratio>10' || exit $?
+    env JAX_PLATFORMS=cpu python - "$SMOKE" <<'PY' || exit 1
+import json, shutil, subprocess, sys
+from pathlib import Path
+smoke = Path(sys.argv[1])
+rep = json.loads((smoke/"ck/obs/comm_report.json").read_text())
+assert rep["schema"] == 1, rep["schema"]
+recon = rep["reconciliation"]
+assert recon["ok"], recon          # collective-count-vs-fingerprint
+for kind, blk in recon["by_kind"].items():
+    assert blk["ok"], (kind, blk)
+assert {"reduce-scatter", "all-gather", "all-reduce"} <= set(recon["by_kind"])
+assert rep["wire"]["reconciliation"]["ok"], rep["wire"]
+assert rep["comm_ms"] > 0 and rep["compute_ms"] > 0, rep
+ev = [json.loads(l) for l in (smoke/"ck/metrics.jsonl").read_text().splitlines()]
+comm_events = [r for r in ev if r.get("event") == "comm_profile"]
+assert len(comm_events) == 1 and comm_events[0]["reconciled"] is True
+# The watch gate must also TRIP: replay a TAMPERED copy of the stream
+# (an injected exposed-comm regression) — exit 1, or the live alert
+# surface is a rubber stamp.
+tampered = smoke / "tampered"
+shutil.copytree(smoke / "ck", tampered)
+bad = dict(comm_events[0])
+bad["exposed_comm_ms"] = bad["exposed_comm_ms"] * 100 + 100
+with open(tampered / "metrics.jsonl", "a") as f:
+    f.write(json.dumps(bad) + "\n")
+rc = subprocess.run(
+    [sys.executable, "-m", "tpu_dp.obs", "watch", str(tampered),
+     "--replay", "--baseline", str(smoke/"base.json"),
+     "--rule", "exposed_comm_ms>1.5*baseline"],
+    capture_output=True, text=True,
+).returncode
+assert rc == 1, f"tampered stream must trip watch (exit 1), got {rc}"
+Path("artifacts/comm_report.json").write_text(
+    json.dumps(rep, indent=2) + "\n")
+print("commprof smoke:", json.dumps({
+    "comm_ms": rep["comm_ms"], "exposed_comm_ms": rep["exposed_comm_ms"],
+    "overlap_frac": rep["overlap_frac"],
+    "reconciled": recon["ok"], "watch_tampered_exit": rc,
+}))
+PY
+    rm -rf "$SMOKE"
+    echo "commprof lane: artifacts/comm_report.json"
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m commprof \
         -p no:cacheprovider
 fi
 

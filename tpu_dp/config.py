@@ -176,6 +176,21 @@ class ObsConfig:
     # cost-analysis FLOPs in the cost registry (exact MFU for any model,
     # at one extra compile); off = analytic per-model estimates only.
     measure_flops: bool = False
+    # In-run comm/compute attribution (tpu_dp/obs/commprof.py,
+    # docs/OBSERVABILITY.md "Comm/compute attribution"): "START:END"
+    # captures one jax.profiler window over those global steps,
+    # "every:N[:W]" a W-step window (default 1) at every N-step boundary.
+    # Each captured window is auto-parsed into a per-collective
+    # comm/compute/overlap breakdown, reconciled against the DP304
+    # fingerprint schedule, and published as the obs.comm_ms /
+    # obs.exposed_comm_ms / obs.overlap_frac gauges + a comm_profile
+    # metrics event + <obs dir>/comm_report.json. Mutually exclusive
+    # with train.profile_steps / train.profile_dir (jax.profiler
+    # sessions cannot nest). Rank 0 only.
+    comm_profile_steps: str = ""
+    # Capture-window trace root ("" = <obs run dir>/commprof); each
+    # window lands in its own w<START> subdir.
+    comm_profile_dir: str = ""
 
 
 @dataclass
@@ -339,6 +354,14 @@ class ServeConfig:
     # Per-class attainment floors, "0:0.9,1:0.5" — the serve CLI exits 1
     # when a listed class completes below its floor (chaos acceptance).
     class_floors: str = ""
+    # Batch-ranged serving capture (the training comm-profile window's
+    # serving twin): "START:END" batch indices traced to profile_dir by
+    # each replica — per-bucket device time becomes xplane-inspectable
+    # (python -m tpu_dp.obs.xplane) exactly like a training window.
+    profile_batches: str = ""
+    # Trace root for profile_batches ("" = required off); replicas write
+    # into per-sid subdirs so fan-out captures never collide.
+    profile_dir: str = ""
 
 
 def parse_class_slo_ms(spec: str) -> dict[int, float]:

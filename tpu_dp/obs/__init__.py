@@ -15,7 +15,14 @@ Three layers, host-side throughout:
 - `health`   — file-based cross-rank heartbeats, straggler attribution
   and hang detection (now with the flight-recorder hang-dump trigger);
 - `promfile` — atomic Prometheus-text-format export for node scrapers
-  (no HTTP server, no new deps).
+  (no HTTP server, no new deps);
+- `chips`    — the unified chip-spec registry (bf16 peak + HBM + ICI
+  GB/s per device kind) behind MFU and the wire-bandwidth gauges;
+- `commprof` + `xplane` — in-run comm/compute attribution: step-ranged
+  capture windows auto-parsed into per-collective device time, wire
+  GB/s, and the ``obs.comm_ms`` / ``obs.exposed_comm_ms`` /
+  ``obs.overlap_frac`` gauges, trace-reconciled against the DP304
+  fingerprint schedule.
 
 **Crash forensics** (always-on):
 
@@ -30,7 +37,9 @@ Three layers, host-side throughout:
   chrome://tracing without TensorBoard;
 - ``python -m tpu_dp.obs`` (`obsctl`) — merges every per-rank artifact
   into one generation-aware forensic timeline, plus straggler
-  attribution, cross-rank trace merging, and baseline regression diffs.
+  attribution, cross-rank trace merging, baseline regression diffs, and
+  ``watch``: declarative alert rules over a live (or replayed) run,
+  exit-coded on trip.
 
 The package imports no jax at module load (the device-memory gauges load
 it lazily): heartbeat monitors and trace tooling must work in watcher

@@ -1,0 +1,582 @@
+"""In-run comm/compute attribution: wire-time profiling + overlap gauges.
+
+The reference DDP's entire performance story is that gradient
+communication hides under backward compute, yet until this module the
+repo's telemetry could not measure communication at all: ``obs.mfu`` /
+``obs.goodput`` see only wall time, and the one comm-aware tool was an
+offline script. This module closes the gap with an **in-run, step-ranged
+profiling window** (``obs.comm_profile_steps``, riding the
+`utils.profiling.StepProfiler` arm/disarm discipline) that captures a
+`jax.profiler` trace of exactly the steps under investigation,
+auto-parses it through `tpu_dp.obs.xplane`, and publishes a per-program
+comm/compute/overlap breakdown:
+
+- per-collective device time and event counts, **reconciled against the
+  DP304 collective-fingerprint schedule**: every fingerprinted collective
+  must be observed exactly once per step per participating device in the
+  trace — a trace-vs-static cross-check no other layer provides
+  (a miscounted collective means the compiled schedule and the executed
+  schedule disagree);
+- wire bytes per step from the static schedule's op shapes, reconciled
+  against `tpu_dp.parallel.quant.wire_report` for compressed-wire runs,
+  and effective wire GB/s against the `tpu_dp.obs.chips` ICI peak (None
+  on chips whose ICI bandwidth is unknown — absence over wrong);
+- the headline gauges ``obs.comm_ms``, ``obs.exposed_comm_ms`` (comm
+  NOT hidden under compute: wall time where a collective runs and no
+  compute op does) and ``obs.overlap_frac`` (1 − exposed/comm) —
+  published per window like MFU, stamped into schema-3 metrics records
+  (a ``comm_profile`` event + the counter snapshots), exported via
+  promfile, written to ``comm_report.json``, and gated by
+  ``obsctl diff`` / ``obsctl watch`` with the same exit-1/exit-2
+  semantics as MFU.
+
+This is the measurement harness the bucketed-async-collectives work
+(ROADMAP item 4, EQuARX arXiv:2506.17615) needs for an honest
+before/after of *exposed* communication time, and the number the
+self-tuning harness (item 5) can use as a machine-readable objective.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from pathlib import Path
+from typing import Callable
+
+from tpu_dp.obs import xplane
+from tpu_dp.obs._atomic import atomic_write_text
+from tpu_dp.obs.counters import counters as _obs_counters
+
+#: comm_report.json schema (bumped on breaking layout changes;
+#: `read_comm_report` refuses unknown versions, like flightrec dumps).
+SCHEMA = 1
+
+#: HLO shape-string element sizes (bytes). pred is byte-packed in HLO.
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+_SHAPE_RE = re.compile(r"([a-z]+\d*)\[([\d,]*)\]")
+
+
+class CommProfileError(ValueError):
+    """Typed failure of the comm-attribution layer (bad spec, unreadable
+    report, unparseable capture)."""
+
+
+def read_comm_report(path: str | os.PathLike) -> dict:
+    """Load + schema-check one comm_report.json (obsctl / tests)."""
+    rec = json.loads(Path(path).read_text(encoding="utf-8"))
+    if rec.get("schema") != SCHEMA:
+        raise CommProfileError(
+            f"comm report {path} has schema {rec.get('schema')!r}, "
+            f"expected {SCHEMA}"
+        )
+    return rec
+
+
+def shape_bytes(shape: str) -> int:
+    """Total bytes of an HLO result shape string.
+
+    ``"f32[8,1605632]"`` -> 8*1605632*4; tuple shapes sum their parts;
+    unknown dtypes contribute 0 (never a guess).
+    """
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape):
+        width = _DTYPE_BYTES.get(dtype)
+        if width is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * width
+    return total
+
+
+def _is_scalar_shape(shape: str) -> bool:
+    return "[]" in shape and not re.search(r"\[\d", shape)
+
+
+def wire_bytes_from_schedule(collectives: list[dict], world: int) -> dict:
+    """Per-step wire bytes out of a DP304 fingerprint record's op list.
+
+    Per-replica payload entering each exchange, from the op's RESULT
+    shape (what the fingerprint records):
+
+    - ``reduce-scatter``: result is the 1/world shard, the per-replica
+      contribution is the full array -> result x world;
+    - ``all-to-all``: total size is preserved -> result bytes (covers
+      both the int8 payload and the f32 scales exchange);
+    - non-scalar ``all-reduce``: each replica contributes the full
+      array -> result bytes;
+    - ``all-gather``: each replica receives the full result -> result
+      bytes (counted separately as the params gather — it is not part
+      of the gradient exchange `quant.wire_report` accounts).
+
+    With these rules the ``grad_exchange`` total for a sharded-update
+    program equals ``quant.wire_report``'s per-dtype number exactly
+    (padding included), which is what `reconcile_wire` pins.
+    """
+    grad = gather = allreduce = 0
+    by_kind: dict[str, int] = {}
+    for op in collectives:
+        kind = op.get("kind", "")
+        b = shape_bytes(op.get("shape", ""))
+        if kind == "reduce-scatter":
+            contrib = b * int(world)
+            grad += contrib
+        elif kind == "all-to-all":
+            contrib = b
+            grad += contrib
+        elif kind == "all-gather":
+            contrib = b
+            gather += contrib
+        elif kind == "all-reduce" and not _is_scalar_shape(
+                op.get("shape", "")):
+            contrib = b
+            allreduce += contrib
+        else:
+            contrib = b if not _is_scalar_shape(op.get("shape", "")) else 0
+        by_kind[kind] = by_kind.get(kind, 0) + contrib
+    return {
+        "grad_exchange": int(grad),
+        "params_gather": int(gather),
+        "grad_allreduce": int(allreduce),
+        "by_kind": by_kind,
+    }
+
+
+def expected_schedule(jitted, args) -> dict:
+    """The static collective schedule of one program (AOT compile).
+
+    ``{"counts": {kind: n_per_step}, "collectives": [op dicts]}`` — the
+    DP304 fingerprint's view of the program, computed live so the
+    reconciliation always checks against the program actually dispatched
+    (the artifact on disk describes the lint mesh's programs, not this
+    run's). Ops inside loop bodies count once, so a scanned multi-step
+    program's schedule equals the per-step program's.
+    """
+    from tpu_dp.analysis.hlo import collect_ops, lower_and_compile
+
+    text, _, _ = lower_and_compile(jitted, args)
+    return expected_from_hlo_text(text)
+
+
+def expected_from_hlo_text(text: str) -> dict:
+    """`expected_schedule` over already-compiled HLO text."""
+    from tpu_dp.analysis.hlo import collect_ops
+
+    ops = [op for op in collect_ops(text)
+           if op.kind in xplane.COLLECTIVE_KINDS]
+    counts: dict[str, int] = {}
+    for op in ops:
+        counts[op.kind] = counts.get(op.kind, 0) + 1
+    return {"counts": counts, "collectives": [op.to_dict() for op in ops]}
+
+
+def reconcile(expected_total: dict[str, float], observed_raw: dict[str, int],
+              steps: int, devices: int) -> dict:
+    """Trace-vs-static cross-check: every fingerprinted collective must be
+    observed exactly once per step per participating device.
+
+    ``expected_total`` is the per-kind count summed over the window's
+    steps (Σ n_steps x per-step schedule — windows may mix programs);
+    ``observed_raw`` the per-kind raw event counts in the trace. On host
+    (CPU) traces every virtual device emits its own events, so the
+    observation normalizes by ``devices``; device planes carry one
+    device's events (devices=1 there, the caller's choice).
+    """
+    per_kind = {}
+    ok = True
+    for kind in sorted(set(expected_total) | set(observed_raw)):
+        exp = float(expected_total.get(kind, 0))
+        raw = int(observed_raw.get(kind, 0))
+        obs = raw / max(1, devices)
+        match = abs(obs - exp) < 1e-9
+        ok = ok and match
+        per_kind[kind] = {
+            "expected": exp,
+            "observed": obs,
+            "observed_raw": raw,
+            "per_step_expected": round(exp / max(1, steps), 4),
+            "per_step_observed": round(obs / max(1, steps), 4),
+            "ok": match,
+        }
+    return {"ok": ok, "steps": int(steps), "devices": int(devices),
+            "by_kind": per_kind}
+
+
+def reconcile_wire(schedule_bytes: dict, wire_report: dict,
+                   wire_dtype: str) -> dict:
+    """Static-schedule wire bytes vs `quant.wire_report`'s accounting.
+
+    The fingerprint schedule's gradient-exchange bytes (reduce-scatter
+    contributions + all-to-all payload/scales) must equal the codec's
+    own per-step byte count for the active wire dtype — two independent
+    derivations of the same number (op shapes vs parameter-tree layout
+    math); a mismatch means one of them miscounts padding or a leaf
+    silently changed paths.
+    """
+    dtype = {"": "f32", "i8": "int8"}.get(wire_dtype, wire_dtype)
+    report_bytes = (wire_report.get("wire_bytes_per_step") or {}).get(dtype)
+    sched = int(schedule_bytes.get("grad_exchange", 0))
+    return {
+        "dtype": dtype,
+        "schedule_bytes_per_step": sched,
+        "report_bytes_per_step": report_bytes,
+        "ok": report_bytes is not None and sched == int(report_bytes),
+    }
+
+
+def breakdown(summary: dict, *, steps: int, devices: int,
+              expected_total: dict[str, float] | None = None,
+              collectives: list[dict] | None = None,
+              world: int | None = None,
+              wire_report: dict | None = None,
+              wire_dtype: str = "",
+              ici_gbs: float | None = None) -> dict:
+    """One window's comm/compute/overlap report from an xplane summary.
+
+    ``steps``/``devices`` normalize the trace's raw totals;
+    ``expected_total`` (per-kind counts summed over the window) arms the
+    fingerprint reconciliation; ``collectives`` (the static schedule's op
+    dicts) + ``world`` arm the wire-byte accounting, ``wire_report`` +
+    ``wire_dtype`` its cross-check; ``ici_gbs`` the effective-bandwidth
+    utilization denominator. Everything not armed is reported absent,
+    never fabricated.
+    """
+    steps = max(1, int(steps))
+    devices = max(1, int(devices))
+    comm_s = float(summary.get("comm_s", 0.0))
+    exposed_s = float(summary.get("exposed_comm_s", 0.0))
+    compute_s = float(summary.get("compute_s", 0.0))
+    counts = dict((summary.get("collectives") or {}).get("counts") or {})
+    durs = dict((summary.get("collectives") or {}).get("dur_s") or {})
+
+    wire = None
+    if collectives is not None and world:
+        wire = wire_bytes_from_schedule(collectives, world)
+
+    by_kind = {}
+    for kind in sorted(set(counts) | set(durs)):
+        dur_s = float(durs.get(kind, 0.0))
+        entry = {
+            "events": int(counts.get(kind, 0)),
+            "per_step": round(counts.get(kind, 0) / devices / steps, 4),
+            # per-device per-step busy time in this kind of collective.
+            "dur_ms_per_step": round(dur_s / devices / steps * 1e3, 4),
+        }
+        if wire is not None and kind in wire["by_kind"]:
+            b = wire["by_kind"][kind]
+            entry["wire_bytes_per_step"] = int(b)
+            if dur_s > 0 and b:
+                gbs = b / (dur_s / devices / steps) / 1e9
+                entry["wire_gbs"] = round(gbs, 3)
+                if ici_gbs:
+                    entry["ici_util"] = round(gbs / ici_gbs, 4)
+        by_kind[kind] = entry
+
+    out = {
+        "schema": SCHEMA,
+        "source": summary.get("source"),
+        "steps": steps,
+        "devices": devices,
+        # Per-device per-step milliseconds — the same unit as
+        # obs.step_time_ms, so the gauges compare directly.
+        "comm_ms": round(comm_s / devices / steps * 1e3, 4),
+        "exposed_comm_ms": round(exposed_s / devices / steps * 1e3, 4),
+        "compute_ms": round(compute_s / devices / steps * 1e3, 4),
+        "overlap_frac": (
+            round(1.0 - exposed_s / comm_s, 4) if comm_s > 0 else None
+        ),
+        "by_kind": by_kind,
+    }
+    if expected_total is not None:
+        out["reconciliation"] = reconcile(expected_total, counts, steps,
+                                          devices)
+    if wire is not None:
+        out["wire"] = {
+            "grad_exchange_bytes_per_step": wire["grad_exchange"],
+            "params_gather_bytes_per_step": wire["params_gather"],
+        }
+        if wire_report is not None:
+            out["wire"]["reconciliation"] = reconcile_wire(
+                wire, wire_report, wire_dtype
+            )
+    return out
+
+
+def parse_comm_profile_steps(spec: str | None):
+    """``obs.comm_profile_steps`` grammar -> a window plan, or None.
+
+    - ``"START:END"``    — one window over global steps [START, END);
+    - ``"every:N"``      — a 1-step window at every N-step boundary
+                           (snapping outward to dispatch windows, like
+                           any StepProfiler range);
+    - ``"every:N:W"``    — W-step windows at every N-step boundary.
+
+    Validated eagerly so a typo fails at config time.
+    """
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    if spec.startswith("every:"):
+        parts = spec.split(":")
+        try:
+            n = int(parts[1])
+            width = int(parts[2]) if len(parts) > 2 else 1
+            if len(parts) > 3:
+                raise ValueError
+        except (ValueError, IndexError):
+            raise CommProfileError(
+                f"obs.comm_profile_steps must be START:END or "
+                f"every:N[:W], got {spec!r}"
+            ) from None
+        if n < 1 or width < 1 or width > n:
+            raise CommProfileError(
+                f"obs.comm_profile_steps every:N:W needs 1 <= W <= N, "
+                f"got {spec!r}"
+            )
+        return ("every", n, width)
+    from tpu_dp.utils.profiling import parse_profile_steps
+
+    try:
+        rng = parse_profile_steps(spec)
+    except ValueError:
+        raise CommProfileError(
+            f"obs.comm_profile_steps must be START:END or every:N[:W], "
+            f"got {spec!r}"
+        ) from None
+    return ("range", rng[0], rng[1])
+
+
+class CommProfiler:
+    """Step-ranged comm-attribution windows over a training run.
+
+    Rides the `StepProfiler` arm/disarm discipline: the trainer's hook
+    calls :meth:`on_window_start` before every dispatch (arming a
+    capture whose trace lands in its own ``w<START>`` subdir) and
+    :meth:`on_step` after it (stopping + parsing once the range has
+    run). While a capture is active the hook also *accounts* each
+    dispatched window (`note_window`): the expected collective counts
+    accumulate per-program, so a capture spanning mixed programs (a
+    windowed dispatch plus the epoch's per-step tail) reconciles
+    exactly. In ``every:N`` mode a fresh `StepProfiler` re-arms for each
+    cadence window — the one-artifact-per-run rule applies per window,
+    not per run.
+
+    ``publish`` is the trainer's callback ``(report, start, end,
+    trace_dir)``; parsing and publication never raise into the hot loop
+    (a failed parse logs, records a flightrec event, and the window is
+    skipped).
+    """
+
+    def __init__(self, trace_dir: str | os.PathLike, spec,
+                 *, devices: int, world: int,
+                 expected_fn: Callable[[], dict] | None = None,
+                 wire_report: dict | None = None,
+                 wire_dtype: str = "",
+                 ici_gbs: float | None = None,
+                 publish: Callable | None = None,
+                 start_fn=None, stop_fn=None):
+        if not trace_dir:
+            raise CommProfileError(
+                "comm profiling needs a trace dir "
+                "(obs.comm_profile_dir or the obs run dir)"
+            )
+        self.trace_dir = Path(trace_dir)
+        self.mode, self.a, self.b = spec  # ("range", s, e) | ("every", n, w)
+        self.devices = max(1, int(devices))
+        self.world = max(1, int(world))
+        self.expected_fn = expected_fn
+        self.wire_report = wire_report
+        self.wire_dtype = wire_dtype
+        self.ici_gbs = ici_gbs
+        self.publish = publish
+        self._start_fn, self._stop_fn = start_fn, stop_fn
+        self._prof = None          # the active window's StepProfiler
+        self._next_start = self.a if self.mode == "range" else None
+        self._expected_cache: dict | None = None
+        self._win_steps = 0
+        self._win_expected: dict[str, float] = {}
+        self._win_first = 0
+        self.reports = 0
+        self.last_report: dict | None = None
+
+    # -- window scheduling ------------------------------------------------
+
+    def _window_for(self, first_step: int):
+        """(start, end) of the next window a step >= first_step can hit,
+        or None (range mode, exhausted)."""
+        if self.mode == "range":
+            return (self.a, self.b) if self._next_start is not None else None
+        # every:N:W — windows [kN, kN+W) for k >= 1. A first_step landing
+        # INSIDE a W>1 window (step jump after a resume/regroup) still
+        # hits that window — the capture snaps outward like any
+        # StepProfiler range, it is not forfeited to the next cadence.
+        k = max(1, first_step // self.a)
+        if k * self.a + self.b <= first_step:
+            k += 1
+        return (k * self.a, k * self.a + self.b)
+
+    def _expected_counts(self) -> dict | None:
+        if self._expected_cache is None and self.expected_fn is not None:
+            try:
+                self._expected_cache = self.expected_fn()
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "comm profile: expected-schedule compile failed; "
+                    "reconciliation disabled", exc_info=True)
+                self.expected_fn = None
+        return self._expected_cache
+
+    # -- the StepProfiler-discipline hooks --------------------------------
+
+    def on_window_start(self, first_step: int, n: int) -> None:
+        """Arm (and account) before dispatching steps
+        [first_step, first_step + n)."""
+        from tpu_dp.utils.profiling import StepProfiler
+
+        # The expected-schedule AOT compile happens at the FIRST boundary,
+        # before any capture arms: compiling inside an armed window would
+        # land the compile's host work inside the very trace being
+        # attributed.
+        self._expected_counts()
+        # Two passes: a pending window the step clock jumped past (resume,
+        # rollback-free regroup) retires on the first, and the cadence
+        # window THIS dispatch covers arms on the second — every:N must
+        # not silently drop a capture on a step jump. A freshly armed
+        # window always ends past first_step, so it can never be done.
+        for _ in range(2):
+            if self._prof is None:
+                win = self._window_for(first_step)
+                if win is None:
+                    return
+                start, end = win
+                if self.mode == "range" and first_step >= end:
+                    self._next_start = None  # resumed past it; range skipped
+                    return
+                self._prof = StepProfiler(
+                    str(self.trace_dir / f"w{start:08d}"), start, end,
+                    start_fn=self._start_fn, stop_fn=self._stop_fn,
+                    label="commprof",
+                )
+                self._win_steps = 0
+                self._win_expected = {}
+                self._win_first = 0
+            was_active = self._prof.active
+            self._prof.on_window_start(first_step, n)
+            if self._prof.active:
+                if not was_active:
+                    self._win_first = first_step
+                self._win_steps += max(1, n)
+                exp = self._expected_counts()
+                if exp is not None:
+                    for kind, c in exp["counts"].items():
+                        self._win_expected[kind] = (
+                            self._win_expected.get(kind, 0) + c * max(1, n)
+                        )
+                return
+            if not self._prof.done:
+                return  # armed, pending a future dispatch
+            self._retire_window()
+
+    def on_step(self, global_step: int) -> None:
+        """The dispatch completed through ``global_step``; stop + parse
+        once the window's last step has run."""
+        if self._prof is None:
+            return
+        was_active = self._prof.active
+        self._prof.on_step(global_step)
+        if was_active and not self._prof.active:
+            trace_dir = self._prof.trace_dir
+            self._publish_window(trace_dir, global_step)
+            self._retire_window()
+
+    def close(self) -> None:
+        """Stop an armed capture (end of training inside the range). The
+        cut-short window is not parsed — its trace stays on disk, and
+        the flightrec profile_start/stop events point at it."""
+        if self._prof is not None:
+            self._prof.close()
+            self._retire_window()
+
+    def _retire_window(self) -> None:
+        self._prof = None
+        if self.mode == "range":
+            self._next_start = None
+
+    # -- parse + publish --------------------------------------------------
+
+    def _publish_window(self, trace_dir: str, last_step: int) -> None:
+        from tpu_dp.obs import flightrec
+
+        start = self._win_first
+        steps = self._win_steps
+        try:
+            summary = xplane.summarize_robust(trace_dir)
+            exp = self._expected_counts()
+            report = breakdown(
+                summary, steps=steps,
+                devices=self.devices if summary.get("source") == "host"
+                else 1,
+                expected_total=self._win_expected if exp is not None
+                else None,
+                collectives=exp["collectives"] if exp is not None else None,
+                world=self.world,
+                wire_report=self.wire_report,
+                wire_dtype=self.wire_dtype,
+                ici_gbs=self.ici_gbs,
+            )
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "comm profile window [%d, %d] parse failed; trace kept "
+                "at %s", start, last_step + 1, trace_dir, exc_info=True)
+            flightrec.record("comm_profile", step=last_step,
+                             start_step=start, error=str(e)[:300],
+                             trace_dir=str(trace_dir))
+            return
+        report.update({
+            "ts": time.time(),
+            "start_step": int(start),
+            "end_step": int(last_step) + 1,
+            "trace_dir": str(trace_dir),
+        })
+        self.reports += 1
+        self.last_report = report
+        _obs_counters.gauge("obs.comm_ms", report["comm_ms"])
+        _obs_counters.gauge("obs.exposed_comm_ms",
+                            report["exposed_comm_ms"])
+        if report["overlap_frac"] is not None:
+            _obs_counters.gauge("obs.overlap_frac", report["overlap_frac"])
+        flightrec.record(
+            "comm_profile", step=last_step, start_step=start,
+            comm_ms=report["comm_ms"],
+            exposed_comm_ms=report["exposed_comm_ms"],
+            overlap_frac=report["overlap_frac"],
+            reconciled=(report.get("reconciliation") or {}).get("ok"),
+            trace_dir=str(trace_dir),
+        )
+        if self.publish is not None:
+            try:
+                self.publish(report, start, last_step + 1, str(trace_dir))
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "comm profile publish failed", exc_info=True)
+
+
+def write_comm_report(path: str | os.PathLike, report: dict) -> Path:
+    """Atomically write one window's report (the newest wins — the file
+    is a gauge, the metrics stream the history)."""
+    return atomic_write_text(Path(path),
+                             json.dumps(report, indent=2) + "\n")

@@ -39,20 +39,15 @@ import dataclasses
 import math
 from collections import deque
 
+from tpu_dp.obs import chips as _chips
+
 #: bf16 peak matmul FLOP/s per chip, by device_kind substring (first match
-#: wins; ordered so "v5 lite" is tested before "v5"). Public spec-sheet
-#: numbers; MFU is None on unknown kinds rather than wrong.
-PEAK_FLOPS_BY_KIND = (
-    ("v5 lite", 197e12),
-    ("v5litepod", 197e12),
-    ("v5e", 197e12),
-    ("v6 lite", 918e12),
-    ("v6e", 918e12),
-    ("v5p", 459e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
+#: wins; ordered so "v5 lite" is tested before "v5"). Derived from the
+#: unified `tpu_dp.obs.chips` registry (which adds HBM/ICI peaks for the
+#: comm-attribution layer); kept as a tuple here because bench.py
+#: re-exports it. MFU is None on unknown kinds rather than wrong.
+PEAK_FLOPS_BY_KIND = tuple(
+    (sub, spec.peak_flops) for sub, spec in _chips.CHIP_SPECS
 )
 
 #: Analytic conv+dot FLOPs for one *trained* image, by model name (the
@@ -73,12 +68,9 @@ FLOPS_CHECK_RTOL = 1.35
 
 
 def peak_flops(device_kind: str) -> float | None:
-    """Peak bf16 FLOP/s for a device kind, or None when unknown."""
-    kind = device_kind.lower()
-    for sub, peak in PEAK_FLOPS_BY_KIND:
-        if sub in kind:
-            return peak
-    return None
+    """Peak bf16 FLOP/s for a device kind, or None when unknown
+    (delegates to the `tpu_dp.obs.chips` registry)."""
+    return _chips.peak_flops(device_kind)
 
 
 def train_flops_per_image(model_name: str) -> float | None:

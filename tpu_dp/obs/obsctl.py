@@ -19,9 +19,18 @@ work never double-counts — into:
   markers;
 - ``diff``        — a regression verdict of the run's mfu / goodput /
   p95 step time — and, for quantized-collective runs, the int8 codec's
-  quant.overflow / quant.clip_blocks as per-step rates — against a
-  ``BENCH_*.json`` baseline, exit-coded so CI can gate on it
-  (``--write-baseline`` mints a baseline from a run).
+  quant.overflow / quant.clip_blocks as per-step rates, and for
+  comm-profiled runs the comm_ms / exposed_comm_ms / overlap_frac
+  attribution gauges — against a ``BENCH_*.json`` baseline, exit-coded
+  so CI can gate on it (``--write-baseline`` mints a baseline from a
+  run);
+- ``watch``       — the live ops surface: tails the metrics sink +
+  heartbeats of a running (or, with ``--replay``, finished) run and
+  evaluates declarative alert rules (``--rule 'mfu<0.9*baseline'``,
+  ``--rule 'exposed_comm_ms>5'``, goodput, overflow rate, straggler
+  ratio, stale heartbeats), emitting timeline-compatible alert events
+  and exit-coding 1 on any trip / 2 when no rule ever saw data — the
+  same semantics the MFU diff gate uses.
 
 Run it as ``python -m tpu_dp.obs <cmd> <run_dir>`` or
 ``tools/obsctl.py``; ``run_dir`` is the training run's checkpoint root
@@ -70,6 +79,11 @@ MARKER_KINDS = (
     # failover → swap must be reconstructable from artifacts alone.
     "model_swap", "replica_failed", "replica_drain", "replica_rejoin",
     "replica_quarantined", "replica_restored",
+    # profiling windows (utils/profiling.StepProfiler + obs/commprof):
+    # captured traces are discoverable from artifacts alone — the marker
+    # args carry the trace path and step range, so merge-trace links
+    # them; watch-rule trips render next to what they fired on.
+    "profile_start", "profile_stop", "comm_profile", "alert",
 )
 
 #: Event kinds describing one REPLICATED decision that reaches the
@@ -150,6 +164,7 @@ class RunArtifacts:
         self.obs_dir = self.run_dir / "obs"
         self.quarantine_path = self.run_dir / "quarantine.jsonl"
         self.membership_dir = self.run_dir / "membership"
+        self.alerts_path = self.run_dir / "alerts.jsonl"
         self.serve_report_path = None
         if serve_report_path:
             self.serve_report_path = Path(serve_report_path)
@@ -175,6 +190,26 @@ class RunArtifacts:
 
     def quarantine(self) -> list[dict]:
         return _read_jsonl(self.quarantine_path)
+
+    def alerts(self) -> list[dict]:
+        """Alert events an `obsctl watch --alerts-out` run recorded."""
+        return _read_jsonl(self.alerts_path)
+
+    def comm_report(self) -> dict | None:
+        """The newest archived comm-attribution window, when one exists
+        (`tpu_dp.obs.commprof.write_comm_report` — obs/comm_report.json,
+        falling back to the run root for hand-archived copies)."""
+        from tpu_dp.obs.commprof import CommProfileError, read_comm_report
+
+        for cand in (self.obs_dir / "comm_report.json",
+                     self.run_dir / "comm_report.json"):
+            if cand.exists():
+                try:
+                    return read_comm_report(cand)
+                except (OSError, ValueError, CommProfileError) as e:
+                    print(f"obsctl: skipping unreadable comm report "
+                          f"{cand}: {e}", file=sys.stderr)
+        return None
 
     def heartbeat_dirs(self) -> list[tuple[int, Path]]:
         """(membership_epoch, dir) pairs holding heartbeat files; epoch 0
@@ -351,6 +386,14 @@ def build_timeline(art: RunArtifacts, include_steps: bool = False) -> dict:
                         "world": rec.get("world"),
                         "token": str(joined.get("token", ""))[:8]})
 
+    # -- watch alerts (when a watcher archived them) --------------------
+    for rec in art.alerts():
+        add("alert", _parse_ts(rec.get("ts")), "watch",
+            step=rec.get("step"),
+            detail={k: rec.get(k)
+                    for k in ("rule", "signal", "value", "bound")
+                    if rec.get(k) is not None})
+
     # -- join requests + refusals (the admission story) -----------------
     for rec in art.join_requests():
         add("elastic_join_request", _parse_ts(rec.get("ts")), "membership",
@@ -490,6 +533,27 @@ def _quant_counters(metrics: list[dict]) -> dict:
     }
 
 
+def _comm_signals(metrics: list[dict], art: RunArtifacts) -> dict:
+    """The run's comm-attribution gauges, from the newest ``comm_profile``
+    metrics event (the stream is the history) or, failing that, the
+    archived comm_report.json. Runs that never profiled a comm window
+    contribute no keys — `diff` then skips the comm signals, never
+    fabricating a 0 ms communication time."""
+    last = None
+    for r in metrics:
+        if r.get("event") == "comm_profile":
+            last = r
+    if last is None:
+        last = art.comm_report()
+    if last is None:
+        return {}
+    out = {}
+    for key in ("comm_ms", "exposed_comm_ms", "overlap_frac"):
+        if last.get(key) is not None:
+            out[key] = float(last[key])
+    return out
+
+
 def serve_signals(report: dict) -> dict:
     """Gateable serve signals out of an audited serve report.
 
@@ -534,6 +598,7 @@ def run_efficiency(art: RunArtifacts) -> dict:
     metrics = sweep_rollback_generations(art.metrics())
     quant = _quant_counters(metrics)
     serve = serve_signals(art.serve_report() or {})
+    comm = _comm_signals(metrics, art)
     eff_recs = [r["efficiency"] for r in metrics
                 if "epoch" in r and isinstance(r.get("efficiency"), dict)]
     if eff_recs:
@@ -545,13 +610,14 @@ def run_efficiency(art: RunArtifacts) -> dict:
             "source": "epoch_efficiency_rollup",
             **quant,
             **serve,
+            **comm,
         }
     per_step = [r for r in metrics
                 if "spans" in r and "event" not in r and "epoch" not in r]
     if not per_step:
         return {"mfu": None, "goodput": None, "p95_ms": None,
                 "source": "serve_report" if serve else "none",
-                **quant, **serve}
+                **quant, **serve, **comm}
     totals, waits, mfus, goodputs = [], [], [], []
     for r in per_step:
         spans = r["spans"]
@@ -572,6 +638,7 @@ def run_efficiency(art: RunArtifacts) -> dict:
         "source": "per_step_spans",
         **quant,
         **serve,
+        **comm,
     }
 
 
@@ -601,6 +668,9 @@ def load_baseline(path: Path) -> dict:
         serve = serve_signals(rec.get("serve") or {})
         serve.update({k: v for k, v in rec.items()
                       if k.startswith("serve_") and v is not None})
+    # Comm-attribution signals: direct keys (an obsctl baseline) or a
+    # BENCH record's `comm` block (`bench.py --comm-profile`).
+    comm_blk = rec.get("comm") or {}
     return {
         "mfu": rec.get("mfu"),
         "goodput": rec.get("goodput"),
@@ -609,6 +679,11 @@ def load_baseline(path: Path) -> dict:
             "quant_overflow_per_step", rate(quant.get("overflow"))),
         "quant_clip_blocks_per_step": rec.get(
             "quant_clip_blocks_per_step", rate(quant.get("clip_blocks"))),
+        "comm_ms": rec.get("comm_ms", comm_blk.get("comm_ms")),
+        "exposed_comm_ms": rec.get(
+            "exposed_comm_ms", comm_blk.get("exposed_comm_ms")),
+        "overlap_frac": rec.get(
+            "overlap_frac", comm_blk.get("overlap_frac")),
         **serve,
     }
 
@@ -630,7 +705,13 @@ def diff_verdict(run: dict, base: dict, tolerance: float) -> dict:
     signals = [("mfu", True), ("goodput", True),
                ("p95_ms", False),
                ("quant_overflow_per_step", False),
-               ("quant_clip_blocks_per_step", False)]
+               ("quant_clip_blocks_per_step", False),
+               # Comm attribution (docs/OBSERVABILITY.md): more exposed
+               # communication or more comm time regresses like a p95;
+               # a lower overlap fraction regresses like MFU.
+               ("comm_ms", False),
+               ("exposed_comm_ms", False),
+               ("overlap_frac", True)]
     # Serving signals are open-ended (one attainment per SLO class), so
     # the comparison set is whatever either side carries — per-class
     # attainment gates like MFU, serve p95 like step-time p95.
@@ -664,6 +745,239 @@ def diff_verdict(run: dict, base: dict, tolerance: float) -> dict:
         "regressed": any(c["verdict"] == "regressed" for c in compared),
         "tolerance": tolerance,
     }
+
+
+# --------------------------------------------------------------------------
+# watch — live alert rules over a running (or replayed) run
+# --------------------------------------------------------------------------
+
+#: rule text: SIGNAL OP BOUND, BOUND = float | F*baseline | baseline*F |
+#: baseline (docs/OBSERVABILITY.md "Watch rules").
+_RULE_RE = re.compile(
+    r"^\s*([A-Za-z_][\w.]*)\s*(<=|>=|<|>)\s*(.+?)\s*$"
+)
+_OPS = {
+    "<": lambda v, b: v < b,
+    ">": lambda v, b: v > b,
+    "<=": lambda v, b: v <= b,
+    ">=": lambda v, b: v >= b,
+}
+
+#: stream signals a watch rule can reference, and where they come from
+#: (per-record values; end-state signals are computed over the artifacts).
+WATCH_SIGNALS = (
+    "mfu", "goodput", "step_time_ms",
+    "comm_ms", "exposed_comm_ms", "overlap_frac",
+    "quant_overflow_per_step", "quant_clip_blocks_per_step",
+    "straggler_ratio", "heartbeat_age_s",
+)
+
+
+class WatchRule:
+    """One parsed ``--rule``: a signal, a comparison, and a bound that is
+    either a constant or a factor of the baseline's value of the same
+    signal (``mfu<0.9*baseline``)."""
+
+    def __init__(self, text: str):
+        m = _RULE_RE.match(text)
+        if m is None:
+            raise ValueError(
+                f"rule {text!r} is not SIGNAL OP BOUND "
+                f"(e.g. 'mfu<0.9*baseline', 'exposed_comm_ms>5')"
+            )
+        self.text = text.strip()
+        self.signal, self.op, bound = m.groups()
+        if self.signal not in WATCH_SIGNALS:
+            # A typo'd signal would otherwise just never evaluate — and a
+            # second, healthy rule seeing data would mask it under exit 0.
+            raise ValueError(
+                f"rule {text!r} references unknown signal "
+                f"{self.signal!r} (known: {', '.join(WATCH_SIGNALS)})"
+            )
+        self.const: float | None = None
+        self.factor: float | None = None
+        b = bound.replace(" ", "")
+        if b == "baseline":
+            self.factor = 1.0
+        elif b.endswith("*baseline"):
+            self.factor = float(b[: -len("*baseline")])
+        elif b.startswith("baseline*"):
+            self.factor = float(b[len("baseline*"):])
+        else:
+            self.const = float(b)
+
+    @property
+    def needs_baseline(self) -> bool:
+        return self.factor is not None
+
+    def bound(self, baseline: dict | None) -> float | None:
+        """The resolved threshold, or None (baseline lacks the signal)."""
+        if self.const is not None:
+            return self.const
+        b = (baseline or {}).get(self.signal)
+        return None if b is None else self.factor * float(b)
+
+
+def stream_signals(rec: dict) -> dict:
+    """The watch signals one metrics record carries.
+
+    Absence over fabrication throughout: a record without an MFU gauge
+    contributes no ``mfu`` sample, a run that never profiled a comm
+    window never produces ``exposed_comm_ms`` — a rule on a signal the
+    run does not publish simply never evaluates (and `watch` exits 2
+    when NO rule ever saw data, the diff gate's refuse-to-certify)."""
+    sig: dict[str, float] = {}
+    for key in ("mfu", "goodput"):
+        if isinstance(rec.get(key), (int, float)):
+            sig[key] = float(rec[key])
+    counters = rec.get("counters")
+    if isinstance(counters, dict):
+        if "obs.step_time_ms" in counters:
+            sig["step_time_ms"] = float(counters["obs.step_time_ms"])
+        step = max(1, int(rec.get("step", 1) or 1))
+        if "quant.overflow" in counters:
+            sig["quant_overflow_per_step"] = (
+                float(counters["quant.overflow"]) / step
+            )
+        if "quant.clip_blocks" in counters:
+            sig["quant_clip_blocks_per_step"] = (
+                float(counters["quant.clip_blocks"]) / step
+            )
+    if rec.get("event") == "comm_profile":
+        for key in ("comm_ms", "exposed_comm_ms", "overlap_frac"):
+            if isinstance(rec.get(key), (int, float)):
+                sig[key] = float(rec[key])
+    return sig
+
+
+def end_signals(art: RunArtifacts, now: float | None = None) -> dict:
+    """State-of-the-run signals computed over the artifacts, not the
+    stream: the worst leave-one-out straggler ratio and the oldest
+    rank's heartbeat age (vs ``now``; in replay, vs the newest beat
+    anywhere — a finished clean run replays with age ~0, a run whose
+    rank wedged mid-way replays with the victim's real gap).
+
+    Only the NEWEST membership epoch's heartbeat dir is read: these are
+    state-of-the-run signals, and an elastic shrink's legitimately
+    departed rank must not read as a permanently stale heartbeat (its
+    old stream stops forever while the survivors re-home to the next
+    ``me<E>/`` dir — the departure itself is the timeline's story)."""
+    sig: dict[str, float] = {}
+    ratios: list[float] = []
+    last_beats: list[float] = []
+    newest = 0.0
+    hb_dirs = art.heartbeat_dirs()
+    if hb_dirs:
+        hb_dirs = [max(hb_dirs, key=lambda pair: pair[0])]
+    for _, hb_dir in hb_dirs:
+        world = len(list(hb_dir.glob("heartbeat_r*.jsonl")))
+        mon = HealthMonitor(hb_dir, world=world)
+        by_rank = mon.read_beats()  # ONE pass shared with the scan
+        for issue in mon.scan(beats=by_rank):
+            if issue.ratio:
+                ratios.append(float(issue.ratio))
+        for rank, beats in by_rank.items():
+            if beats:
+                last_beats.append(float(beats[-1]["ts"]))
+                newest = max(newest, float(beats[-1]["ts"]))
+    if last_beats:
+        sig["straggler_ratio"] = max(ratios) if ratios else 1.0
+        ref = float(now) if now is not None else newest
+        sig["heartbeat_age_s"] = max(0.0, ref - min(last_beats))
+    return sig
+
+
+class _MetricsTail:
+    """Incremental reader over a live metrics.jsonl: remembers the byte
+    offset of the last COMPLETE line so each poll tick parses only what
+    was appended since (a whole-file re-parse per tick costs quadratic
+    IO over a long watch). A partial trailing line (the sink mid-append)
+    is left for the next tick; a shrunken file (truncate/rotate) resets
+    to the top. Same torn-line tolerance as `_read_jsonl`."""
+
+    def __init__(self, path: Path):
+        self.path = Path(path)
+        self._offset = 0
+
+    def poll(self) -> list[dict]:
+        try:
+            size = self.path.stat().st_size
+        except OSError:
+            return []
+        if size < self._offset:
+            self._offset = 0
+        if size == self._offset:
+            return []
+        out: list[dict] = []
+        with open(self.path, "rb") as f:
+            f.seek(self._offset)
+            for line in f:
+                if not line.endswith(b"\n"):
+                    break
+                self._offset += len(line)
+                try:
+                    rec = json.loads(line.decode("utf-8"))
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+        return out
+
+
+def _alert_event(rule: WatchRule, value: float, bound: float,
+                 step, ts: float | None) -> dict:
+    ts = float(ts) if ts is not None else datetime.now(
+        timezone.utc).timestamp()
+    ev = {"ts": ts, "iso": _iso(ts), "kind": "alert", "source": "watch",
+          "rule": rule.text, "signal": rule.signal,
+          "value": round(float(value), 6), "bound": round(float(bound), 6)}
+    if step is not None:
+        ev["step"] = step
+    return ev
+
+
+class WatchEngine:
+    """Rule evaluation over a metrics stream + artifact end-state.
+
+    One instance per `cmd_watch` run; `observe_record` feeds stream
+    records in order, `observe_state` the end-state signals (repeatable
+    — an end-state rule trips at most once). ``evaluated`` tracks which
+    rules ever saw data, for the exit-2 refuse-to-certify verdict."""
+
+    def __init__(self, rules: list[WatchRule], baseline: dict | None):
+        self.rules = rules
+        self.baseline = baseline
+        self.alerts: list[dict] = []
+        self.evaluated: set[str] = set()
+        self._state_tripped: set[str] = set()
+
+    def _check(self, rule: WatchRule, sig: dict, step, ts,
+               once: bool = False) -> None:
+        value = sig.get(rule.signal)
+        if value is None:
+            return
+        bound = rule.bound(self.baseline)
+        if bound is None:
+            return  # baseline lacks the signal: no-data, never a trip
+        self.evaluated.add(rule.text)
+        if _OPS[rule.op](value, bound):
+            if once:
+                if rule.text in self._state_tripped:
+                    return
+                self._state_tripped.add(rule.text)
+            self.alerts.append(_alert_event(rule, value, bound, step, ts))
+
+    def observe_record(self, rec: dict) -> None:
+        sig = stream_signals(rec)
+        if not sig:
+            return
+        ts = _parse_ts(rec.get("ts"))
+        for rule in self.rules:
+            self._check(rule, sig, rec.get("step"), ts)
+
+    def observe_state(self, sig: dict, ts: float | None = None) -> None:
+        for rule in self.rules:
+            self._check(rule, sig, None, ts, once=True)
 
 
 # --------------------------------------------------------------------------
@@ -708,6 +1022,13 @@ def build_merged_trace(art: RunArtifacts) -> dict:
                 args["rank"] = ev["rank"]
             if ev.get("step") is not None:
                 args["step"] = ev["step"]
+            # Scalar detail fields ride into the marker args — this is
+            # how a profile_start/profile_stop marker links the captured
+            # trace (its trace_dir + step range) and an alert marker
+            # names its rule, directly in the Perfetto UI.
+            for k, v in (ev.get("detail") or {}).items():
+                if isinstance(v, (str, int, float, bool)) and k not in args:
+                    args[k] = v
             markers.append(instant_event(ev["kind"], ev["ts"], args=args))
     return merge_traces(traces + [{"traceEvents": markers}])
 
@@ -813,6 +1134,9 @@ def cmd_diff(args) -> int:
             "quant_overflow_per_step": run.get("quant_overflow_per_step"),
             "quant_clip_blocks_per_step": run.get(
                 "quant_clip_blocks_per_step"),
+            "comm_ms": run.get("comm_ms"),
+            "exposed_comm_ms": run.get("exposed_comm_ms"),
+            "overlap_frac": run.get("overlap_frac"),
             **{k: v for k, v in sorted(run.items())
                if k.startswith("serve_")},
             "source_run": str(art.run_dir),
@@ -846,6 +1170,84 @@ def cmd_diff(args) -> int:
         print("obsctl diff: REGRESSION", file=sys.stderr)
         return 1
     return 0
+
+
+def cmd_watch(args) -> int:
+    """Evaluate alert rules over a run's telemetry; the live ops surface.
+
+    ``--replay`` processes the finished artifacts as a stream (CI: a
+    tampered run must trip, a clean run must not). Without it, the run
+    dir is polled live every ``--interval`` seconds for ``--for-s``
+    seconds (0 = one evaluation of the current state). Exit 0 clean,
+    1 on any tripped rule, 2 when no rule ever saw data (or on usage
+    errors) — the diff gate's refuse-to-certify semantics.
+    """
+    import time as _time
+
+    try:
+        rules = [WatchRule(r) for r in (args.rule or [])]
+    except ValueError as e:
+        print(f"obsctl watch: {e}", file=sys.stderr)
+        return 2
+    if not rules:
+        print("obsctl watch: at least one --rule required "
+              "(e.g. --rule 'mfu<0.9*baseline')", file=sys.stderr)
+        return 2
+    baseline = None
+    if args.baseline:
+        baseline = load_baseline(Path(args.baseline))
+    missing = [r.text for r in rules if r.needs_baseline and baseline is None]
+    if missing:
+        print(f"obsctl watch: rules {missing} reference 'baseline' but no "
+              f"--baseline was given", file=sys.stderr)
+        return 2
+    art = RunArtifacts(args.run_dir, metrics_path=args.metrics)
+    eng = WatchEngine(rules, baseline)
+
+    if args.replay:
+        for rec in sweep_rollback_generations(art.metrics()):
+            eng.observe_record(rec)
+        eng.observe_state(end_signals(art))
+    else:
+        deadline = _time.time() + max(0.0, args.for_s)
+        tail = _MetricsTail(art.metrics_path)
+        while True:
+            # Raw append-order tail (no generation sweep): live watching
+            # reads the stream as it grows; a rollback's replayed records
+            # are new observations, exactly what a pager should see.
+            for rec in tail.poll():
+                eng.observe_record(rec)
+            eng.observe_state(end_signals(art, now=_time.time()),
+                              ts=_time.time())
+            if _time.time() >= deadline:
+                break
+            _time.sleep(max(0.1, args.interval))
+
+    if args.alerts_out and eng.alerts:
+        out = Path(args.alerts_out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        with open(out, "a", encoding="utf-8") as f:
+            for ev in eng.alerts:
+                f.write(json.dumps(ev) + "\n")
+    if args.json:
+        print(json.dumps({
+            "alerts": eng.alerts,
+            "rules": [r.text for r in rules],
+            "evaluated": sorted(eng.evaluated),
+        }))
+    else:
+        for ev in eng.alerts:
+            print(f"{ev['iso']}  ALERT {ev['rule']}  value={ev['value']} "
+                  f"bound={ev['bound']}"
+                  + (f" step={ev['step']}" if "step" in ev else ""))
+        print(f"-- {len(eng.alerts)} alert(s); "
+              f"{len(eng.evaluated)}/{len(rules)} rule(s) saw data")
+    if not eng.evaluated:
+        print("obsctl watch: no rule ever saw data — cannot certify; "
+              "check the signal names (known: "
+              + ", ".join(WATCH_SIGNALS) + ")", file=sys.stderr)
+        return 2
+    return 1 if eng.alerts else 0
 
 
 def main(argv=None) -> int:
@@ -895,6 +1297,34 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", default=None,
                    help="mint a baseline json from this run and exit")
     p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser(
+        "watch",
+        help="evaluate live alert rules over a running (or --replay'd) "
+             "run; exit 1 on any trip",
+    )
+    common(p)
+    p.add_argument("--rule", action="append", default=[],
+                   help="SIGNAL OP BOUND, e.g. 'mfu<0.9*baseline', "
+                        "'exposed_comm_ms>5', 'goodput<0.8', "
+                        "'quant_overflow_per_step>0', "
+                        "'straggler_ratio>3', 'heartbeat_age_s>60' "
+                        "(repeatable)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline json for '*baseline' bounds (BENCH "
+                        "record or obsctl baseline)")
+    p.add_argument("--replay", action="store_true",
+                   help="process the finished artifacts as a stream "
+                        "instead of tailing live")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="live poll cadence (seconds)")
+    p.add_argument("--for-s", type=float, default=0.0, dest="for_s",
+                   help="live watch duration; 0 = evaluate the current "
+                        "state once")
+    p.add_argument("--alerts-out", default=None,
+                   help="append tripped alert events to this jsonl "
+                        "(obsctl timeline merges <run>/alerts.jsonl)")
+    p.set_defaults(fn=cmd_watch)
 
     args = ap.parse_args(argv)
     try:

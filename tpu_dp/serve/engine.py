@@ -116,6 +116,8 @@ class InferenceEngine:
         flops_per_image: float | None = None,
         peak_flops: float | None = None,
         class_slo_ms: dict[int, float] | None = None,
+        profile_dir: str = "",
+        profile_batches: tuple[int, int] | None = None,
     ):
         import jax
 
@@ -169,6 +171,8 @@ class InferenceEngine:
             peak_flops=peak_flops,
             bucket_flops=bucket_flops,
             registry=self._counters,
+            profile_dir=profile_dir,
+            profile_batches=profile_batches,
         )
         self.batcher = self.replica.batcher
         self.num_classes = self.replica.num_classes
@@ -335,7 +339,14 @@ class InferenceEngine:
         """Build from a `tpu_dp.config.ServeConfig` section."""
         from tpu_dp.config import parse_class_slo_ms
         from tpu_dp.serve.batcher import parse_buckets
+        from tpu_dp.utils.profiling import parse_profile_steps
 
+        profile_batches = parse_profile_steps(serve_cfg.profile_batches)
+        if profile_batches is not None and not serve_cfg.profile_dir:
+            raise ValueError(
+                "serve.profile_batches needs serve.profile_dir for the "
+                "trace output"
+            )
         return cls(
             model, params,
             buckets=parse_buckets(serve_cfg.buckets),
@@ -345,6 +356,8 @@ class InferenceEngine:
             shed_headroom_ms=serve_cfg.shed_headroom_ms,
             obs_dir=serve_cfg.obs_dir or None,
             class_slo_ms=parse_class_slo_ms(serve_cfg.class_slo_ms),
+            profile_dir=serve_cfg.profile_dir,
+            profile_batches=profile_batches,
             **kwargs,
         )
 

@@ -203,6 +203,8 @@ class ServeReplica:
         peak_flops: float | None = None,
         bucket_flops: dict[int, float] | None = None,
         registry: Counters | None = None,
+        profile_dir: str = "",
+        profile_batches: tuple[int, int] | None = None,
     ):
         import jax
 
@@ -282,6 +284,23 @@ class ServeReplica:
                 )
             except Exception:
                 self._peak = None
+
+        # Batch-ranged serving capture (the training comm-profile
+        # window's serving twin, docs/OBSERVABILITY.md): a StepProfiler
+        # armed over *batch indices* — per-bucket device time becomes
+        # xplane-inspectable (`python -m tpu_dp.obs.xplane`) exactly like
+        # a training window, with the same flightrec
+        # profile_start/profile_stop discoverability. Per-sid subdirs so
+        # fan-out replicas' captures never collide.
+        self._profiler = None
+        if profile_dir and profile_batches is not None:
+            from tpu_dp.utils.profiling import StepProfiler
+
+            self._profiler = StepProfiler(
+                os.path.join(profile_dir, f"r{self.sid}"),
+                int(profile_batches[0]), int(profile_batches[1]),
+                label=f"serve_r{self.sid}",
+            )
 
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -526,7 +545,17 @@ class ServeReplica:
                     self.status = "stopped"
                     return
                 self._apply_pending_swap()
+                # _run_batch advances _batch_index; pin THIS batch's
+                # 0-based index so the profiler range means what
+                # `serve.profile_batches` documents ("START:END batch
+                # indices", half-open — 0:1 captures exactly batch 0).
+                bi = self._batch_index
+                if self._profiler is not None:
+                    # Arm BEFORE dispatch (the StepProfiler discipline).
+                    self._profiler.on_window_start(bi, 1)
                 self._run_batch(batch)
+                if self._profiler is not None:
+                    self._profiler.on_step(bi)
                 batch = None
         except BaseException as e:
             self._error = e
@@ -548,6 +577,11 @@ class ServeReplica:
                 reqs, _ = self.queue.collect(self.ladder.max_batch * 10**6)
                 for req in pending + reqs:
                     shed_counted(self._counters, req.handle, "engine_error")
+        finally:
+            # A capture window cut short by drain/stop/death still stops
+            # the trace (the flightrec profile_stop event points at it).
+            if self._profiler is not None:
+                self._profiler.close()
 
     def _place_batch(self, bucket: int, images: np.ndarray,
                      weight: np.ndarray):

@@ -172,6 +172,26 @@ class ProfilerHook(StepHook):
             self.tr._step_profiler.on_step(self.tr._host_step)
 
 
+class CommProfilerHook(StepHook):
+    """In-run comm/compute attribution windows (`tpu_dp.obs.commprof`,
+    ``obs.comm_profile_steps``). Same arm-before-dispatch discipline as
+    `ProfilerHook`; the stop path additionally parses the captured trace
+    and publishes the comm gauges (parse failures log and never raise
+    into the hot loop)."""
+
+    def on_window_start(self, first_step: int, n: int) -> None:
+        if self.tr._comm_profiler is not None:
+            self.tr._comm_profiler.on_window_start(first_step, n)
+
+    def on_step_end(self, ev: StepEvent) -> None:
+        if self.tr._comm_profiler is not None:
+            self.tr._comm_profiler.on_step(self.tr._host_step)
+
+    def close(self) -> None:
+        if self.tr._comm_profiler is not None:
+            self.tr._comm_profiler.close()
+
+
 class FlightRecorderHook(StepHook):
     """The black box's feed (`tpu_dp.obs.flightrec`, docs/OBSERVABILITY.md
     "Flight recorder").

@@ -505,6 +505,15 @@ class Trainer:
             self._step_profiler = StepProfiler(
                 cfg.train.profile_dir, *profile_range
             )
+        # In-run comm/compute attribution (tpu_dp/obs/commprof.py,
+        # docs/OBSERVABILITY.md "Comm/compute attribution"): capture
+        # windows over obs.comm_profile_steps, auto-parsed into the
+        # obs.comm_ms / obs.exposed_comm_ms / obs.overlap_frac gauges, a
+        # comm_profile metrics event, and <obs dir>/comm_report.json —
+        # with the trace-vs-static reconciliation against the DP304
+        # fingerprint schedule.
+        self._comm_profiler = None
+        self._build_comm_profiler()
 
         # Guardrail run state: the rollback generation stamps every
         # metrics/quarantine record written after a rewind (post-hoc
@@ -814,6 +823,7 @@ class Trainer:
         """
         from tpu_dp.train.hooks import (
             BoundaryHook,
+            CommProfilerHook,
             FaultHook,
             GuardHook,
             HeartbeatHook,
@@ -836,7 +846,8 @@ class Trainer:
         if self._guard_hook is not None:
             hooks.append(self._guard_hook)
         hooks += [SnapshotHook(self), FaultHook(self), HeartbeatHook(self),
-                  ProfilerHook(self), BoundaryHook(self)]
+                  ProfilerHook(self), CommProfilerHook(self),
+                  BoundaryHook(self)]
         self._hooks = hooks
 
     @property
@@ -1025,6 +1036,125 @@ class Trainer:
 
             _c.gauge("obs.flops_per_step_per_chip",
                      cost.flops_per_step_per_chip)
+
+    def _build_comm_profiler(self) -> None:
+        """Construct the comm-attribution capture driver (rank 0 only).
+
+        Mutually exclusive with the whole-run trace and the plain
+        step-ranged profiler — `jax.profiler` sessions cannot nest, and
+        the comm window exists precisely to replace an undirected trace.
+        The reconciliation's expected schedule is the per-step train
+        program's static collective schedule (a scanned multi-step
+        window's loop body compiles the identical schedule, counted
+        once); resident-feed windows dispatch a different program, so
+        reconciliation is disabled there rather than wrong.
+        """
+        from tpu_dp.obs.commprof import (
+            CommProfiler,
+            parse_comm_profile_steps,
+        )
+
+        cfg = self.cfg
+        spec = parse_comm_profile_steps(cfg.obs.comm_profile_steps)
+        if spec is None:
+            return
+        if cfg.train.profile_steps or cfg.train.profile_dir:
+            raise ValueError(
+                "obs.comm_profile_steps cannot combine with "
+                "train.profile_steps/train.profile_dir — jax.profiler "
+                "sessions cannot nest, and the comm window replaces the "
+                "undirected trace"
+            )
+        if self.ctx.process_index != 0:  # dplint: allow(DP101) host-only profiler
+            return
+        trace_dir = cfg.obs.comm_profile_dir or str(
+            self.obs_dir / "commprof"
+        )
+        local_devices = [d for d in self.mesh.devices.flat
+                         if d.process_index == self.ctx.process_index]
+        expected_fn = None
+        if not self._resident_enabled:
+            # Precomputed EAGERLY (one AOT compile at startup, like
+            # verify_fingerprint): resolving it lazily at the first
+            # window boundary would bill seconds of compile time to that
+            # step's data_wait span and crater its goodput record.
+            from tpu_dp.obs.commprof import expected_schedule
+
+            try:
+                expected = expected_schedule(self.train_step,
+                                             self._step_arg_structs())
+                expected_fn = lambda: expected  # noqa: E731
+            except Exception:
+                log0("comm profile: expected-schedule compile failed; "
+                     "reconciliation disabled", exc_info=True)
+        else:
+            log0("comm profile: device-resident feed active — the "
+                 "fingerprint reconciliation is disabled (the resident "
+                 "window is a different program); counts/time still "
+                 "publish")
+        wire_report = None
+        if self.update_sharding == "sharded":
+            from tpu_dp.parallel import quant
+
+            wire_report = quant.wire_report(
+                self.state.params, dist.data_axis_size(self.mesh),
+                cfg.train.quant_block_size,
+            )
+        from tpu_dp.obs import chips
+
+        try:
+            ici = chips.ici_gbs(jax.devices()[0].device_kind)
+        except Exception:
+            ici = None
+        self._comm_profiler = CommProfiler(
+            trace_dir, spec,
+            devices=len(local_devices) or 1,
+            world=dist.data_axis_size(self.mesh),
+            expected_fn=expected_fn,
+            wire_report=wire_report,
+            wire_dtype=cfg.train.collective_dtype or "",
+            ici_gbs=ici,
+            publish=self._publish_comm_report,
+        )
+        log0("comm profile: windows %r -> %s", cfg.obs.comm_profile_steps,
+             trace_dir)
+
+    def _publish_comm_report(self, report: dict, start: int, end: int,
+                             trace_dir: str) -> None:
+        """One captured window's breakdown -> metrics event + report file.
+
+        The gauges were already set by the CommProfiler (they ride the
+        next records' counter snapshots and the promfile); this stamps
+        the schema-3 ``comm_profile`` event and rewrites
+        ``<obs dir>/comm_report.json`` (newest window wins — the file is
+        a gauge, the metrics stream the history).
+        """
+        from tpu_dp.obs.commprof import write_comm_report
+
+        recon = report.get("reconciliation") or {}
+        self._log_metrics({
+            "event": "comm_profile",
+            "start_step": start,
+            "end_step": end,
+            "comm_ms": report["comm_ms"],
+            "exposed_comm_ms": report["exposed_comm_ms"],
+            "overlap_frac": report["overlap_frac"],
+            "compute_ms": report["compute_ms"],
+            "reconciled": recon.get("ok"),
+            "by_kind": {k: v["per_step"]
+                        for k, v in report["by_kind"].items()},
+            "trace_dir": trace_dir,
+        })
+        write_comm_report(self.obs_dir / "comm_report.json", report)
+        self._write_prom()
+        log0("comm profile [%d, %d): comm %.3f ms/step (exposed %.3f, "
+             "overlap %s), compute %.3f ms/step%s — %s",
+             start, end, report["comm_ms"], report["exposed_comm_ms"],
+             report["overlap_frac"], report["compute_ms"],
+             "" if not recon else (
+                 ", schedule reconciled" if recon.get("ok")
+                 else ", RECONCILIATION MISMATCH"),
+             trace_dir)
 
     def _write_prom(self) -> None:
         """Atomically rewrite the Prometheus textfile (obs.prom_path).
@@ -2069,6 +2199,13 @@ class Trainer:
         self._resident_loops = {}
         self._elastic_tail = None
         self.state = None
+        if self._comm_profiler is not None:
+            # Stop an armed capture BEFORE the mesh it is tracing is torn
+            # down; the driver itself is topology-bound (expected
+            # schedule, wire report, local-device normalization) and is
+            # rebuilt against the new mesh once the state is reloaded.
+            self._comm_profiler.close()
+            self._comm_profiler = None
         if self.heartbeat is not None:
             self.heartbeat.close()
         try:
@@ -2124,6 +2261,11 @@ class Trainer:
         # world): re-register so post-regroup MFU/goodput gauges divide by
         # THIS mesh's cost, and the world-keyed alias tags the new shape.
         self._register_program_costs()
+        # Comm-attribution driver re-keyed to this topology: the grown or
+        # shrunk program's collective schedule, THIS world's wire report,
+        # and the new local device count (the state is already reloaded,
+        # so the wire report sees the real params).
+        self._build_comm_profiler()
 
         # Re-split the interrupted epoch over the survivors: every
         # remaining sample visited exactly once (graceful), or the
@@ -2398,6 +2540,16 @@ class Trainer:
             eff = self._eff.rollup()
             if eff is not None:
                 out["efficiency"] = eff
+        cp = self._comm_profiler
+        if cp is not None and cp.last_report is not None:
+            r = cp.last_report
+            out["comm"] = {
+                "windows": cp.reports,
+                "comm_ms": r["comm_ms"],
+                "exposed_comm_ms": r["exposed_comm_ms"],
+                "overlap_frac": r["overlap_frac"],
+                "reconciled": (r.get("reconciliation") or {}).get("ok"),
+            }
         return out
 
     def fit(self) -> dict[str, Any]:

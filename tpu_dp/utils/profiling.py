@@ -71,7 +71,11 @@ class StepProfiler:
     host cannot start or stop a trace mid-scan.
 
     ``start_fn``/``stop_fn`` are injectable for tests (the real profiler
-    is process-global state).
+    is process-global state). Arm/stop transitions are recorded as
+    flight-recorder ``profile_start``/``profile_stop`` events carrying
+    the trace path and step range, so a captured trace is discoverable
+    from the run's artifacts alone (``obsctl timeline`` renders them;
+    ``merge-trace`` links the path into the marker).
     """
 
     def __init__(
@@ -81,6 +85,7 @@ class StepProfiler:
         end_step: int,
         start_fn: Callable[[str], None] | None = None,
         stop_fn: Callable[[], None] | None = None,
+        label: str = "profile",
     ):
         if not trace_dir:
             raise ValueError(
@@ -89,10 +94,19 @@ class StepProfiler:
         self.trace_dir = trace_dir
         self.start_step = int(start_step)
         self.end_step = int(end_step)
+        self.label = str(label)
         self._start = start_fn or jax.profiler.start_trace
         self._stop = stop_fn or jax.profiler.stop_trace
         self.active = False
         self.done = False
+
+    def _record(self, kind: str, step: int) -> None:
+        from tpu_dp.obs import flightrec
+
+        flightrec.record(kind, step=step, label=self.label,
+                         trace_dir=str(self.trace_dir),
+                         start_step=self.start_step,
+                         end_step=self.end_step)
 
     def on_window_start(self, first_step: int, n_steps: int) -> None:
         """About to dispatch steps [first_step, first_step + n_steps):
@@ -106,6 +120,7 @@ class StepProfiler:
         if last >= self.start_step:
             self._start(self.trace_dir)
             self.active = True
+            self._record("profile_start", first_step)
 
     def on_step(self, global_step: int) -> None:
         """``global_step`` steps have completed; stop once the range has
@@ -114,6 +129,7 @@ class StepProfiler:
             self._stop()
             self.active = False
             self.done = True
+            self._record("profile_stop", global_step)
 
     def close(self) -> None:
         """Stop an armed trace (end of training inside the range)."""
@@ -121,3 +137,4 @@ class StepProfiler:
             self._stop()
             self.active = False
             self.done = True
+            self._record("profile_stop", self.end_step - 1)
