@@ -277,11 +277,14 @@ def test_two_process_checkpoint_resume(tmp_path):
         assert a == t    # resumed == end-of-training state
     # The checkpoint layout honors the proc-0-write contract: exactly the
     # single-writer manager layout — one step dir for the one epoch, the
-    # atomic `latest` pointer, proc-0's metrics log, and the final-weights
-    # export. Any rank-suffixed duplicate or torn .tmp residue (the
-    # reference's all-ranks-write-one-path mode) changes this set.
+    # atomic `latest` pointer, proc-0's metrics log, the always-on
+    # flight-recorder home (`obs/`, every rank dumps on exit), and the
+    # final-weights export. Any rank-suffixed duplicate or torn .tmp
+    # residue (the reference's all-ranks-write-one-path mode) changes
+    # this set.
     assert sorted(p.name for p in ckpt_dir.iterdir()) == [
-        "final_params.msgpack", "latest", "metrics.jsonl", "step_0000000004",
+        "final_params.msgpack", "latest", "metrics.jsonl", "obs",
+        "step_0000000004",
     ]
 
 

@@ -103,6 +103,26 @@
 #                                    # error-feedback ablation, guard/NaN
 #                                    # interaction, residual checkpoint
 #                                    # resharding + kill/resume).
+#   tools/run_tier1.sh --chaos      # composed-fault chaos lane
+#                                    # (docs/CHAOS.md): 5 seeded trials
+#                                    # over the default fault palette —
+#                                    # the generator samples multi-fault
+#                                    # schedules, runs the real train.py
+#                                    # under a supervisor loop, and the
+#                                    # invariant auditor verdicts each
+#                                    # trial (no wedge, legal exits,
+#                                    # artifacts parse, coverage, params
+#                                    # bitwise vs the never-faulted
+#                                    # oracle); archives artifacts/
+#                                    # chaos_report.json + the minimized
+#                                    # spec of any failure. The
+#                                    # --tamper-oracle self-test must
+#                                    # exit nonzero (the gate can trip),
+#                                    # then the -m chaos suite runs —
+#                                    # units AND the composed-fault
+#                                    # acceptance trio (bitrot-before-
+#                                    # rollback, SDC-during-grow,
+#                                    # preempt-mid-rollback-regroup).
 #   tools/run_tier1.sh --serve       # serving lane: a 200-request mixed-
 #                                    # size synthetic load through the full
 #                                    # queue → batcher → compiled-forward
@@ -461,6 +481,46 @@ print("quant smoke:", json.dumps({"compression_vs_f32":
 PY
     echo "quant smoke: artifacts/quant_report.json"
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m quant \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--chaos" ]; then
+    # The harness is its own verdict (exit 1 on the first trial whose
+    # invariants go red, after shrinking to a minimal repro spec); the
+    # archived report is the CI record of which schedules were attacked.
+    # The pinned seed's 5 trials (replay `Random(f"20260809:{i}")`):
+    # spike rollback, kill;torn (death composed with a post-commit torn
+    # write — the relaunch-remainder path), ioerr, delay, bitrot;ioerr
+    # — write-fault DEGRADE teeth, checksum fallback, and the guard
+    # interaction all exercised every CI pass (docs/CHAOS.md).
+    mkdir -p artifacts
+    env JAX_PLATFORMS=cpu python -m tpu_dp.chaos --seed 20260809 \
+        --trials 5 --out artifacts/chaos_report.json || exit $?
+    # The gate must also TRIP: a tampered oracle has to exit nonzero
+    # with a minimized repro spec, or the auditor is a rubber stamp.
+    env JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+import json, subprocess, sys
+from pathlib import Path
+rep = json.loads(Path("artifacts/chaos_report.json").read_text())
+assert rep["ok"] and len(rep["trials"]) == 5, rep
+assert all(t["ok"] for t in rep["trials"]), rep
+proc = subprocess.run(
+    [sys.executable, "-m", "tpu_dp.chaos", "--seed", "20260809",
+     "--trials", "1", "--tamper-oracle"],
+    capture_output=True, text=True,
+)
+assert proc.returncode == 1, (
+    f"tampered oracle must exit 1, got {proc.returncode}\n"
+    + proc.stdout[-2000:] + proc.stderr[-2000:])
+assert "minimal reproducing spec" in proc.stdout, proc.stdout[-2000:]
+print("chaos lane:", json.dumps({
+    "trials": len(rep["trials"]), "ok": rep["ok"],
+    "specs": [t["spec"] for t in rep["trials"]],
+    "tamper_oracle_exit": proc.returncode,
+}))
+PY
+    echo "chaos lane: artifacts/chaos_report.json"
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
         -p no:cacheprovider
 fi
 

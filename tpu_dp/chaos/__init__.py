@@ -1,0 +1,23 @@
+"""Chaos engineering for the resilience stack (docs/CHAOS.md).
+
+Every recovery path shipped so far — snapshots, elastic shrink/grow,
+guardrails, the SDC audit — is proven against one hand-placed fault per
+test, while real preemption at pod scale delivers *composed* failures.
+This package attacks the interactions:
+
+- `storage` — the storage-fault shim behind the ``ioerr``/``torn``/
+  ``bitrot``/``slowfs``/``enospc`` fault kinds: deterministic corruption
+  injected at the checkpoint/snapshot/ledger IO seams
+  (`tpu_dp.resilience.faultinject` arms it; `tpu_dp.checkpoint` and the
+  membership ledger consult it);
+- `runner` — the seeded trial harness (`python -m tpu_dp.chaos`): samples
+  multi-fault schedules from a declared palette, runs the real
+  ``train.py`` as subprocesses under an auto-restarting supervisor loop,
+  verdicts each trial with the invariant auditor (oracle params,
+  coverage, legal exits, artifact well-formedness, bounded recovery) and
+  shrinks failing schedules to a minimal reproducing spec string.
+
+Kept import-light on purpose: `tpu_dp.checkpoint` and the ledger consult
+the shim through ``sys.modules`` so a production run that never armed a
+storage fault never even imports this package.
+"""

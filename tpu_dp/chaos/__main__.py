@@ -1,0 +1,93 @@
+"""``python -m tpu_dp.chaos`` — the seeded chaos harness CLI.
+
+    python -m tpu_dp.chaos --seed 20260809 --trials 5 \
+        --out artifacts/chaos_report.json
+
+Exit 0 when every trial's invariants are green; exit 1 on the first
+failing trial, after shrinking its schedule to a minimal reproducing
+spec string (replay it with ``--resilience.fault='<spec>'`` on the trial
+config — docs/CHAOS.md "Replaying a minimized spec").
+
+``--tamper-oracle`` is the auditor self-test: it corrupts the oracle
+export before comparison, so a correct harness MUST exit nonzero with a
+minimized spec — the CI lane proves the gate trips.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+from tpu_dp.chaos.runner import DEFAULT_PALETTE, run_chaos
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dp.chaos",
+        description="composed-fault chaos trials over the real train.py",
+    )
+    ap.add_argument("--seed", type=int, default=0,
+                    help="trial-generator seed (trial i draws from "
+                         "Random(f'{seed}:{i}') — replayable individually)")
+    ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--timeout-s", type=float, default=180.0,
+                    help="wedge bound per trial, relaunches included")
+    ap.add_argument("--kinds", default="",
+                    help="comma-separated palette restriction "
+                         "(default: the full palette)")
+    ap.add_argument("--out", default=None,
+                    help="write the JSON report here (CI artifact)")
+    ap.add_argument("--workdir", default=None,
+                    help="trial scratch root (default: a tempdir, "
+                         "removed on success, kept on failure)")
+    ap.add_argument("--tamper-oracle", action="store_true",
+                    help="auditor self-test: corrupt the oracle so the "
+                         "gate MUST trip (expected exit: nonzero)")
+    args = ap.parse_args(argv)
+
+    palette = DEFAULT_PALETTE
+    if args.kinds:
+        want = {k.strip() for k in args.kinds.split(",") if k.strip()}
+        unknown = want - {e.kind for e in DEFAULT_PALETTE}
+        if unknown:
+            ap.error(f"unknown palette kinds {sorted(unknown)}; "
+                     f"known: {sorted(e.kind for e in DEFAULT_PALETTE)}")
+        palette = tuple(e for e in DEFAULT_PALETTE if e.kind in want)
+
+    ephemeral = args.workdir is None
+    workdir = Path(args.workdir) if args.workdir else Path(
+        tempfile.mkdtemp(prefix="tpu_dp_chaos."))
+    try:
+        report = run_chaos(
+            seed=args.seed, trials=args.trials, workdir=workdir,
+            timeout_s=args.timeout_s, palette=palette,
+            tamper_oracle=args.tamper_oracle,
+        )
+    except RuntimeError as e:
+        print(f"chaos: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        out = Path(args.out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(report, indent=2) + "\n")
+    ok = report["ok"]
+    n = len(report["trials"])
+    print(f"chaos: {n} trial(s), "
+          f"{sum(1 for t in report['trials'] if t['ok'])} green — "
+          f"{'OK' if ok else 'FAIL'}")
+    if not ok and report.get("minimized_spec"):
+        print(f"chaos: minimal reproducing spec: "
+              f"{report['minimized_spec']!r}")
+    if ephemeral and ok:
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not ok:
+        print(f"chaos: trial artifacts kept under {workdir}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,584 @@
+"""Seeded composed-fault chaos trials over the real ``train.py``.
+
+Each trial (docs/CHAOS.md):
+
+1. **samples** a multi-fault schedule from the declared palette — 1–2
+   ``;``-composed clauses of `tpu_dp.resilience.faultinject` grammar,
+   steps and parameters drawn from a seeded RNG (``Random(f"{seed}:{i}")``
+   — string seeding is version-stable) so every trial replays from
+   ``(seed, index)`` alone;
+2. **runs** the real ``train.py`` as a subprocess under a supervisor
+   loop: an injected kill (137) or preemption (143) relaunches with
+   ``--resume=auto`` and the not-yet-fired remainder of the schedule
+   (storage clauses are re-injected even past their boundary — they arm
+   at boundaries but apply at IO calls, and a kill takes their evidence
+   down with it; `_relaunch_remainder`), parking the dead incarnation's
+   flight-recorder dumps where the relaunch cannot overwrite them — the
+   auto-restarting fleet supervisor, simulated honestly;
+3. **verdicts** the trial with the invariant auditor (`audit_trial`):
+
+   - *no wedge* — every incarnation exits within the timeout;
+   - *legal exits* — intermediate codes only from the schedule's own
+     kill/preempt clauses ({137, 143}), final code 0;
+   - *artifacts parse* — the flight-recorder dump passes
+     `flightrec.read_dump` and ``obsctl timeline`` rebuilds the run;
+   - *coverage* — the final dump's exit step equals the expected applied
+     optimizer steps (total minus guard-quarantined), across every
+     relaunch/rollback generation;
+   - *oracle* — for schedules whose recovery contract is exact
+     (kill/preempt resume, storage faults, spike rollback), the final
+     params export is **bitwise identical** to a never-faulted oracle
+     run of the same config — the strongest exactly-once statement
+     there is: any replayed, dropped or corrupted batch moves the
+     params;
+
+4. on failure, **shrinks** the schedule (`shrink_schedule`: greedy
+   1-minimal clause removal, re-running the trial per candidate) and
+   reports the minimal reproducing spec string.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import random
+import shutil
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Callable, Sequence
+
+from tpu_dp.obs import flightrec as flightrec_mod
+from tpu_dp.resilience.faultinject import (
+    KILL_EXIT_CODE,
+    STORAGE_KINDS,
+    FaultPlan,
+)
+
+PREEMPTED_EXIT_CODE = 143
+#: optimizer steps per trial run: synthetic 48 / batch 4 × 2 epochs.
+TRIAL_STEPS_PER_EPOCH = 12
+TRIAL_EPOCHS = 2
+TRIAL_TOTAL_STEPS = TRIAL_STEPS_PER_EPOCH * TRIAL_EPOCHS
+#: faults land in the interior so every schedule leaves room to recover.
+_FAULT_STEPS = (2, 18)
+
+
+@dataclasses.dataclass(frozen=True)
+class PaletteEntry:
+    """One samplable fault kind and its invariant contract."""
+
+    kind: str
+    #: recovery replays to bitwise-equal final params (oracle invariant)
+    oracle_exact: bool = True
+    #: guard.action this kind needs compiled in ("" = guard stays off)
+    guard_action: str = ""
+    #: worlds the kind is meaningful at (1 = single-process trials)
+    min_world: int = 1
+
+    def sample(self, rng: random.Random) -> FaultPlan:
+        step = rng.randint(*_FAULT_STEPS)
+        extra: dict = {}
+        if self.kind == "delay":
+            extra["delay_ms"] = float(rng.choice((50, 100, 200)))
+        if self.kind == "spike":
+            extra["scale"] = float(rng.choice((1e5, 1e6)))
+        if self.kind == "slowfs":
+            extra["delay_ms"] = float(rng.choice((20, 50)))
+        if self.kind == "ioerr":
+            extra["count"] = rng.choice((1, 2))
+        return FaultPlan(kind=self.kind, step=step, **extra)
+
+
+#: The default palette `python -m tpu_dp.chaos` samples from. ``slowfs``
+#: is ledger-read latency, so it only joins multi-rank (elastic) trials;
+#: ``nan`` breaks the oracle contract by design (the quarantined batch
+#: is withheld from the trajectory) and is audited by its quarantine
+#: count instead.
+DEFAULT_PALETTE = (
+    PaletteEntry("kill"),
+    PaletteEntry("preempt"),
+    PaletteEntry("delay"),
+    PaletteEntry("ioerr"),
+    PaletteEntry("enospc"),
+    PaletteEntry("torn"),
+    PaletteEntry("bitrot"),
+    PaletteEntry("spike", guard_action="rollback"),
+    PaletteEntry("nan", oracle_exact=False, guard_action="skip"),
+    PaletteEntry("slowfs", min_world=2),
+)
+
+
+@dataclasses.dataclass
+class TrialSchedule:
+    """A sampled trial: clauses + the config they need compiled in."""
+
+    clauses: list[FaultPlan]
+    guard_action: str = ""  # "" | "skip" | "rollback"
+
+    @property
+    def spec(self) -> str:
+        return ";".join(c.to_spec() for c in self.clauses)
+
+    @property
+    def oracle_exact(self) -> bool:
+        by_kind = {e.kind: e for e in DEFAULT_PALETTE}
+        return all(by_kind[c.kind].oracle_exact for c in self.clauses
+                   if c.kind in by_kind)
+
+
+def sample_schedule(rng: random.Random,
+                    palette: Sequence[PaletteEntry] = DEFAULT_PALETTE,
+                    world: int = 1) -> TrialSchedule:
+    """Sample one composed schedule: 1-2 clauses, at most one guard kind
+    (one ``guard.action`` per process), at most one process-death kind
+    per incarnation chain position (the supervisor consumes them in step
+    order either way)."""
+    pool = [e for e in palette if world >= e.min_world]
+    n = rng.choice((1, 1, 2))  # bias toward single faults; pairs compose
+    clauses: list[FaultPlan] = []
+    guard_action = ""
+    deaths = 0
+    for _ in range(n):
+        entry = rng.choice(pool)
+        if entry.guard_action:
+            if guard_action and entry.guard_action != guard_action:
+                continue  # one sentinel policy per process
+            guard_action = entry.guard_action
+        if entry.kind in ("kill", "preempt"):
+            if deaths >= 2:
+                continue
+            deaths += 1
+        plan = entry.sample(rng)
+        if world > 1 and entry.kind in ("kill", "preempt", "delay"):
+            # Rank-targeted, never rank 0 (the save/export writer).
+            plan = dataclasses.replace(plan,
+                                       rank=rng.randint(1, world - 1))
+        clauses.append(plan)
+    clauses.sort(key=lambda c: (c.step, c.kind))
+    if not clauses:
+        clauses = [PaletteEntry("delay").sample(rng)]
+    return TrialSchedule(clauses=clauses, guard_action=guard_action)
+
+
+# ---------------------------------------------------------------------------
+# running one trial
+# ---------------------------------------------------------------------------
+
+
+def _repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def _trial_argv(ckpt_dir: Path, spec: str, guard_action: str,
+                resume: bool) -> list[str]:
+    args = [
+        sys.executable, str(_repo_root() / "train.py"),
+        "--data.dataset=synthetic",
+        f"--data.synthetic_train_size={TRIAL_STEPS_PER_EPOCH * 4}",
+        "--data.synthetic_test_size=16", "--data.batch_size=4",
+        f"--train.epochs={TRIAL_EPOCHS}", "--train.log_every=100",
+        "--train.eval_at_end=false", "--train.steps_per_call=1",
+        "--parallel.num_devices=1",
+        f"--train.ckpt_dir={ckpt_dir}", "--train.ckpt_async=false",
+        "--resilience.snapshot_every_steps=3",
+    ]
+    if guard_action:
+        args += ["--guard.enabled=true",
+                 f"--guard.action={guard_action}",
+                 "--guard.spike_min_steps=4", "--guard.spike_z=12"]
+    if spec:
+        args.append(f"--resilience.fault={spec}")
+    if resume:
+        args.append("--resume=auto")
+    return args
+
+
+def _trial_env() -> dict:
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1",
+               PYTHONPATH=str(_repo_root()))
+    env.pop("TPU_DP_FAULT", None)
+    return env
+
+
+@dataclasses.dataclass
+class TrialResult:
+    schedule: TrialSchedule
+    incarnations: list[dict]      # [{"exit": rc, "wall_s": s, "spec": str}]
+    ckpt_dir: Path
+    wall_s: float
+    timed_out: bool = False
+
+    @property
+    def final_exit(self) -> int | None:
+        return self.incarnations[-1]["exit"] if self.incarnations else None
+
+
+def _relaunch_remainder(clauses: Sequence[FaultPlan]) -> list[FaultPlan]:
+    """The schedule remainder a supervisor relaunch re-injects.
+
+    The fired death is the earliest remaining kill/preempt (its fire
+    ended the process, so nothing later-step fired after it); clauses at
+    or before that boundary are spent. EXCEPT the storage domain:
+    storage faults are host-boundary ARMED but applied at the next IO
+    call, so a death at the same boundary can land before the fault ever
+    touched a write — pruning by step would silently drop the fault from
+    the trial — and a kill (`os._exit`, no dump, no summary) takes any
+    applied-fault evidence down with it either way. Re-injected storage
+    clauses re-arm at the first boundary after resume (they are
+    boundary-≥-K kinds, unlike the exact-step device seams), keeping the
+    fault in the story and landing its evidence in an incarnation whose
+    artifacts survive for the auditor's DEGRADE teeth.
+    """
+    deaths = [c.step for c in clauses if c.kind in ("kill", "preempt")]
+    died_at = min(deaths, default=0)
+    return [c for c in clauses
+            if c.step > died_at or c.kind in STORAGE_KINDS]
+
+
+def run_trial(schedule: TrialSchedule, workdir: Path,
+              timeout_s: float = 180.0,
+              max_relaunches: int = 3) -> TrialResult:
+    """One trial under the supervisor loop (see module docstring)."""
+    workdir.mkdir(parents=True, exist_ok=True)
+    ckpt = workdir / "ck"
+    clauses = list(schedule.clauses)
+    incarnations: list[dict] = []
+    t0 = time.time()
+    resume = False
+    deadline = t0 + timeout_s
+    while True:
+        spec = ";".join(c.to_spec() for c in clauses)
+        argv = _trial_argv(ckpt, spec, schedule.guard_action, resume)
+        budget = deadline - time.time()
+        if budget <= 0:
+            return TrialResult(schedule, incarnations, ckpt,
+                               time.time() - t0, timed_out=True)
+        t1 = time.time()
+        try:
+            proc = subprocess.run(
+                argv, cwd=_repo_root(), env=_trial_env(),
+                capture_output=True, text=True, timeout=budget,
+            )
+        except subprocess.TimeoutExpired as e:
+            incarnations.append({
+                "exit": None, "spec": spec,
+                "wall_s": round(time.time() - t1, 1),
+                "stdout": (e.stdout or b"")[-4000:].decode(
+                    "utf-8", "replace")
+                if isinstance(e.stdout, bytes) else (e.stdout or "")[-4000:],
+            })
+            return TrialResult(schedule, incarnations, ckpt,
+                               time.time() - t0, timed_out=True)
+        incarnations.append({
+            "exit": proc.returncode, "spec": spec,
+            "wall_s": round(time.time() - t1, 1),
+            "stdout": proc.stdout[-8000:],
+            "stderr": proc.stderr[-4000:],
+        })
+        if proc.returncode in (KILL_EXIT_CODE, PREEMPTED_EXIT_CODE) \
+                and len(incarnations) <= max_relaunches:
+            # A relaunch outside an elastic join reuses rank tag 0, so
+            # its flight-recorder dump would OVERWRITE the predecessor's
+            # (a preempted incarnation's counters are fault evidence the
+            # auditor needs). Park the dead incarnation's dumps where the
+            # next incarnation cannot clobber them and the final
+            # timeline glob does not see them twice.
+            obs_dir = ckpt / "obs"
+            prev = sorted(obs_dir.glob(flightrec_mod.DUMP_GLOB)) \
+                if obs_dir.exists() else []
+            if prev:
+                arch = obs_dir / f"chaos_inc{len(incarnations) - 1:02d}"
+                arch.mkdir(exist_ok=True)
+                for f in prev:
+                    f.rename(arch / f.name)
+            # The supervisor's restart: resume from the newest save, with
+            # the schedule's not-yet-fired remainder.
+            clauses = _relaunch_remainder(clauses)
+            resume = True
+            continue
+        return TrialResult(schedule, incarnations, ckpt, time.time() - t0)
+
+
+# ---------------------------------------------------------------------------
+# the invariant auditor
+# ---------------------------------------------------------------------------
+
+
+def _file_sha256(path: Path) -> str:
+    return hashlib.sha256(path.read_bytes()).hexdigest()
+
+
+def audit_trial(result: TrialResult,
+                oracle_params: Path | None) -> list[str]:
+    """Every violated invariant, empty = the trial is green."""
+    failures: list[str] = []
+    sched = result.schedule
+    if result.timed_out:
+        failures.append(
+            f"WEDGE: trial did not finish within its timeout "
+            f"(spec {sched.spec!r})")
+        return failures
+
+    # -- legal exit codes ----------------------------------------------
+    legal_mid = set()
+    if any(c.kind == "kill" for c in sched.clauses):
+        legal_mid.add(KILL_EXIT_CODE)
+    if any(c.kind == "preempt" for c in sched.clauses):
+        legal_mid.add(PREEMPTED_EXIT_CODE)
+    for inc in result.incarnations[:-1]:
+        if inc["exit"] not in legal_mid:
+            failures.append(
+                f"ILLEGAL EXIT: intermediate incarnation exited "
+                f"{inc['exit']} (legal here: {sorted(legal_mid)})")
+    if result.final_exit != 0:
+        failures.append(
+            f"ILLEGAL EXIT: final incarnation exited {result.final_exit} "
+            f"(expected 0)")
+        return failures  # everything below needs a completed run
+
+    # -- artifacts parse ------------------------------------------------
+    obs_dir = result.ckpt_dir / "obs"
+    dumps = sorted(obs_dir.glob(flightrec_mod.DUMP_GLOB))
+    # Dumps from incarnations a relaunch superseded, parked by the
+    # supervisor so the relaunch could not overwrite them. Their
+    # counters are fault evidence; their exit events are not the run's
+    # final clock.
+    archived = sorted(obs_dir.glob("chaos_inc*/" + flightrec_mod.DUMP_GLOB))
+    if not dumps:
+        failures.append("ARTIFACTS: no flight-recorder dump found")
+        return failures
+    counters: dict = {}
+
+    def _read(d: Path) -> dict | None:
+        try:
+            return flightrec_mod.read_dump(d)
+        except (OSError, ValueError) as e:
+            failures.append(f"ARTIFACTS: flightrec dump {d.name} "
+                            f"unreadable: {e}")
+            return None
+
+    def _merge_counters(payload: dict) -> None:
+        # Counter registries are per-process; summing across incarnation
+        # dumps gives the trial-wide totals the teeth below audit.
+        for key, val in (payload.get("counters") or {}).items():
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                counters[key] = counters.get(key, 0) + val
+
+    for d in archived:
+        payload = _read(d)
+        if payload is not None:
+            _merge_counters(payload)
+    exit_step = None
+    for d in dumps:
+        payload = _read(d)
+        if payload is None:
+            continue
+        _merge_counters(payload)
+        for ev in payload.get("events", ()):
+            if ev.get("kind") == "exit":
+                exit_step = ev.get("step", exit_step)
+    try:
+        from tpu_dp.obs.obsctl import RunArtifacts, build_timeline
+
+        timeline = build_timeline(RunArtifacts(result.ckpt_dir),
+                                  include_steps=True)
+        if not timeline.get("events"):
+            failures.append("ARTIFACTS: obsctl timeline is empty")
+    except Exception as e:
+        failures.append(f"ARTIFACTS: obsctl timeline failed: {e}")
+
+    # -- coverage -------------------------------------------------------
+    # The exit event carries the HOST window clock: every window of every
+    # epoch dispatched exactly once across all relaunch/rollback
+    # generations (a quarantined batch skips its UPDATE, not its window,
+    # so the host clock still reaches the full count). The applied-update
+    # side of coverage is the oracle check below — any dropped, replayed
+    # or corrupted batch moves the params.
+    if exit_step != TRIAL_TOTAL_STEPS:
+        failures.append(
+            f"COVERAGE: final exit step {exit_step} != the "
+            f"{TRIAL_TOTAL_STEPS} windows the run owes across all "
+            f"generations")
+
+    # -- schedule-specific teeth ---------------------------------------
+    if any(c.kind in ("ioerr", "enospc") for c in sched.clauses):
+        wrote_errs = (counters.get("snapshot.write_errors", 0)
+                      + counters.get("ckpt.write_errors", 0)
+                      + counters.get("retry.retries", 0))
+        if wrote_errs <= 0:
+            failures.append(
+                "DEGRADE: injected write faults left no trace (no "
+                "snapshot/ckpt write_errors, no retries)")
+    if sched.guard_action == "skip":
+        quarantined = int(counters.get("guard.quarantined", 0))
+        # Quarantine evidence exists only where artifacts survive: a
+        # kill (`os._exit` 137) writes no dump and prints no summary, so
+        # a quarantine inside a killed incarnation is unauditable, not
+        # wrong — the teeth only bite when the nan clause rode an
+        # incarnation that terminated observably.
+        observable = any(
+            "nan:" in (inc.get("spec") or "")
+            and inc.get("exit") != KILL_EXIT_CODE
+            for inc in result.incarnations)
+        if observable and quarantined != 1:
+            failures.append(
+                f"GUARD: nan:skip trial expected exactly 1 quarantined "
+                f"batch in the surviving artifacts, saw {quarantined}")
+
+    # -- oracle ---------------------------------------------------------
+    if sched.oracle_exact and oracle_params is not None:
+        mine = result.ckpt_dir / "final_params.msgpack"
+        if not mine.exists():
+            failures.append("ORACLE: run left no final_params.msgpack")
+        elif _file_sha256(mine) != _file_sha256(oracle_params):
+            failures.append(
+                f"ORACLE: final params diverge bitwise from the "
+                f"never-faulted oracle (spec {sched.spec!r}) — a batch "
+                f"was replayed, dropped, or corrupted")
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# shrinking
+# ---------------------------------------------------------------------------
+
+
+def shrink_schedule(clauses: Sequence[FaultPlan],
+                    still_fails: Callable[[list[FaultPlan]], bool]
+                    ) -> list[FaultPlan]:
+    """Greedy 1-minimal reduction: drop clauses one at a time while the
+    reduced schedule still reproduces the failure. The result is
+    1-minimal (removing ANY single remaining clause makes the trial
+    pass), which is what a bug report needs — not globally minimal,
+    which would cost exponential re-runs."""
+    cur = list(clauses)
+    changed = True
+    while changed and len(cur) > 1:
+        changed = False
+        for i in range(len(cur)):
+            cand = cur[:i] + cur[i + 1:]
+            if still_fails(cand):
+                cur = cand
+                changed = True
+                break
+    return cur
+
+
+# ---------------------------------------------------------------------------
+# the harness
+# ---------------------------------------------------------------------------
+
+
+def _oracle_for(guard_action: str, cache: dict, workdir: Path,
+                timeout_s: float) -> Path | None:
+    """The never-faulted oracle export for a guard config (one run per
+    distinct config per harness invocation, cached)."""
+    if guard_action in cache:
+        return cache[guard_action]
+    odir = workdir / f"oracle_{guard_action or 'plain'}"
+    res = run_trial(TrialSchedule(clauses=[], guard_action=guard_action),
+                    odir, timeout_s=timeout_s)
+    path = odir / "ck" / "final_params.msgpack"
+    if res.final_exit != 0 or not path.exists():
+        raise RuntimeError(
+            f"oracle run failed (exit {res.final_exit}) — the chaos "
+            f"harness cannot verdict without its ground truth")
+    cache[guard_action] = path
+    return path
+
+
+def run_chaos(seed: int, trials: int, workdir: Path,
+              timeout_s: float = 180.0,
+              palette: Sequence[PaletteEntry] = DEFAULT_PALETTE,
+              tamper_oracle: bool = False,
+              log=print) -> dict:
+    """Run ``trials`` seeded trials; returns the report dict (``ok``,
+    per-trial verdicts, and the minimized spec of the first failure).
+
+    ``tamper_oracle`` corrupts the oracle export after it is produced —
+    the auditor-must-trip self-test: a harness whose invariants cannot
+    fail is a rubber stamp. The self-test samples from the oracle-exact
+    subset of the palette only: a ``nan`` schedule never compares the
+    oracle (`oracle_exact=False`), so an unlucky seed would exit 0 with
+    the gate never evaluated — the exact false confidence the self-test
+    exists to rule out.
+    """
+    if tamper_oracle:
+        palette = [e for e in palette if e.oracle_exact]
+    workdir = Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    oracle_cache: dict = {}
+    report: dict = {"schema": 1, "seed": seed, "trials": [],
+                    "ok": True, "minimized_spec": None,
+                    "tampered_oracle": bool(tamper_oracle)}
+    for index in range(trials):
+        rng = random.Random(f"{seed}:{index}")  # str: stable, not hash()
+        schedule = sample_schedule(rng, palette)
+        log(f"chaos trial {index}: spec {schedule.spec!r}"
+            + (f" (guard.action={schedule.guard_action})"
+               if schedule.guard_action else ""))
+        oracle = _oracle_for(schedule.guard_action, oracle_cache,
+                             workdir, timeout_s)
+        if tamper_oracle:
+            tampered = workdir / f"tampered_oracle_{index}.msgpack"
+            blob = bytearray(oracle.read_bytes())
+            blob[len(blob) // 2] ^= 0xFF
+            tampered.write_bytes(bytes(blob))
+            oracle = tampered
+        result = run_trial(schedule, workdir / f"trial_{index:03d}",
+                           timeout_s=timeout_s)
+        failures = audit_trial(result, oracle)
+        verdict = {
+            "index": index,
+            "spec": schedule.spec,
+            "guard_action": schedule.guard_action,
+            "oracle_exact": schedule.oracle_exact,
+            "incarnations": [
+                {k: v for k, v in inc.items()
+                 if k in ("exit", "spec", "wall_s")}
+                for inc in result.incarnations
+            ],
+            "wall_s": round(result.wall_s, 1),
+            "failures": failures,
+            "ok": not failures,
+        }
+        report["trials"].append(verdict)
+        if failures:
+            report["ok"] = False
+            log(f"chaos trial {index}: FAIL")
+            for f in failures:
+                log(f"  - {f}")
+            log("chaos: shrinking the failing schedule ...")
+
+            def still_fails(cand: list[FaultPlan]) -> bool:
+                sub = TrialSchedule(clauses=list(cand),
+                                    guard_action=schedule.guard_action)
+                sub_dir = workdir / (
+                    f"shrink_{index:03d}_"
+                    + hashlib.sha256(sub.spec.encode()).hexdigest()[:8]
+                )
+                if sub_dir.exists():
+                    # Duplicate clauses make two candidates share a spec
+                    # (and so a dir); a stale ckpt tree's archived dumps
+                    # would double-count into the auditor's counters.
+                    shutil.rmtree(sub_dir)
+                sub_res = run_trial(sub, sub_dir, timeout_s=timeout_s)
+                return bool(audit_trial(sub_res, oracle))
+
+            minimal = shrink_schedule(schedule.clauses, still_fails)
+            spec = ";".join(c.to_spec() for c in minimal)
+            report["minimized_spec"] = spec
+            verdict["minimized_spec"] = spec
+            log(f"chaos: minimal reproducing spec: {spec!r}")
+            log(f"chaos: replay with --resilience.fault='{spec}' "
+                f"(see docs/CHAOS.md)")
+            break  # first failure is the bug report; stop burning trials
+        log(f"chaos trial {index}: ok "
+            f"({len(result.incarnations)} incarnation(s), "
+            f"{verdict['wall_s']}s)")
+    return report

@@ -19,6 +19,7 @@ prefix, and with no load/resume path, no optimizer state, no epoch counter
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from pathlib import Path
@@ -28,10 +29,161 @@ import jax
 import numpy as np
 from flax import serialization
 
+from tpu_dp.obs import flightrec as _flightrec
+from tpu_dp.obs.counters import counters as _counters
 from tpu_dp.train.state import TrainState
 
 _CKPT_NAME = "state.msgpack"
 _META_NAME = "meta.json"
+
+#: Checkpoint meta/manifest schema. 1 = the pre-checksum layout (no
+#: ``schema`` key at all — every checkpoint written before this version);
+#: 2 = + the ``integrity`` manifest (whole-payload sha256 and per-leaf
+#: sha256s, verified on every load/restore path). Loaders REFUSE schemas
+#: they do not know with the typed `CheckpointSchemaError` — the same
+#: contract `flightrec.read_dump` and `read_comm_report` enforce — while
+#: pre-checksum checkpoints still load (verification skipped, counted in
+#: ``ckpt.unverified_loads``).
+CKPT_SCHEMA = 2
+KNOWN_SCHEMAS = (1, 2)
+
+
+class CheckpointSchemaError(ValueError):
+    """A checkpoint manifest declares a schema this build does not know."""
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint failed checksum verification — its bytes are not the
+    bytes that were saved. Carries the save dir and (when the payload
+    still parses) the names of the mismatching leaves, so the refusal is
+    attributable. Resume paths treat it as "mark corrupt, fall back to
+    the next-older complete candidate" (`tpu_dp.resilience.resume_latest`,
+    the trainer's rollback/regroup restores)."""
+
+    def __init__(self, message: str, *, path: str = "",
+                 leaves: tuple[str, ...] = ()):
+        super().__init__(message)
+        self.path = str(path)
+        self.leaves = tuple(leaves)
+
+
+def _chaos_shim():
+    """The storage-fault shim, IFF armed — the ONE shared accessor
+    (`faultinject.storage_shim`), imported at call time because the
+    `tpu_dp.resilience` package imports this module at init."""
+    from tpu_dp.resilience.faultinject import storage_shim
+
+    return storage_shim()
+
+
+def _leaf_sha256(leaf) -> str:
+    """sha256 over one host leaf's dtype + shape + raw bytes (metadata
+    included so a re-interpreted buffer cannot collide with the original)."""
+    arr = np.asarray(leaf)
+    h = hashlib.sha256()
+    h.update(str(arr.dtype).encode())
+    h.update(str(arr.shape).encode())
+    h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def _walk_state_dict(node, prefix: str = ""):
+    """Depth-first ``(path, leaf)`` pairs of a flax state dict, paths
+    '/'-joined — the same key convention the quarantine/SDC tooling uses."""
+    if isinstance(node, dict):
+        for key in sorted(node):
+            yield from _walk_state_dict(
+                node[key], f"{prefix}/{key}" if prefix else str(key)
+            )
+    else:
+        yield prefix, node
+
+
+def _integrity_manifest(payload: bytes, host_state) -> dict[str, Any]:
+    """The schema-2 integrity block written into meta.json at save time:
+    one sha256 of the serialized payload (catches truncation/rot wholesale
+    — the cheap always-checked hash) plus per-leaf sha256s (the
+    attribution map: a mismatch names the rotten leaf)."""
+    leaves = {
+        path: _leaf_sha256(leaf)
+        for path, leaf in _walk_state_dict(
+            serialization.to_state_dict(host_state)
+        )
+    }
+    return {
+        "algo": "sha256",
+        "payload_sha256": hashlib.sha256(payload).hexdigest(),
+        "leaves": leaves,
+    }
+
+
+def read_meta(ckpt_dir: str | os.PathLike) -> dict[str, Any]:
+    """Load + schema-check a save dir's meta.json ({} when absent).
+
+    The one schema gate every loader shares: an unknown ``schema`` is a
+    typed refusal (`CheckpointSchemaError`), never a misread."""
+    meta_path = Path(ckpt_dir) / _META_NAME
+    if not meta_path.exists():
+        return {}
+    meta = json.loads(meta_path.read_text())
+    schema = meta.get("schema", 1)
+    if schema not in KNOWN_SCHEMAS:
+        raise CheckpointSchemaError(
+            f"checkpoint {ckpt_dir} declares schema {schema!r}; this build "
+            f"knows {KNOWN_SCHEMAS} — refusing to guess at its layout"
+        )
+    return meta
+
+
+def verify_payload(payload: bytes, meta: dict[str, Any],
+                   where: str | os.PathLike) -> None:
+    """Verify ``payload`` against the meta's integrity manifest.
+
+    Pre-checksum saves (schema 1 / no manifest) are counted and skipped —
+    they still load. A mismatch marks ``ckpt.checksum_failures``, records
+    the refusal in the flight recorder, and raises the typed
+    `CorruptCheckpointError` naming the divergent leaves when the payload
+    still parses (bitrot) or the tear when it does not."""
+    integrity = meta.get("integrity") if meta.get("schema", 1) >= 2 else None
+    if not integrity:
+        _counters.inc("ckpt.unverified_loads")
+        return
+    if hashlib.sha256(payload).hexdigest() == integrity.get("payload_sha256"):
+        _counters.inc("ckpt.verified_loads")
+        return
+    _counters.inc("ckpt.checksum_failures")
+    bad: list[str] = []
+    parses = True
+    try:
+        raw = serialization.msgpack_restore(payload)
+        want = integrity.get("leaves") or {}
+        for path, leaf in _walk_state_dict(raw):
+            if path in want and _leaf_sha256(leaf) != want[path]:
+                bad.append(path)
+    except Exception:
+        parses = False
+    _flightrec.record("ckpt_corrupt", dir=str(where),
+                      leaves=bad[:8], parses=parses)
+    detail = (f"divergent leaves {bad[:8]}" if bad
+              else "payload torn/unparseable" if not parses
+              else "payload bytes differ from the saved manifest")
+    raise CorruptCheckpointError(
+        f"checkpoint {where} failed sha256 verification ({detail}) — "
+        f"refusing to restore corrupt state",
+        path=str(where), leaves=tuple(bad),
+    )
+
+
+def _io_retry(fn, describe: str):
+    """Run one checkpoint write under the unified IO retry budget
+    (``resilience.io_retry_s`` — the same budget the membership ledger
+    uses): a transient EIO is a retry, not a lost save. Exhaustion
+    re-raises the last OSError for the caller's degrade/raise policy."""
+    from tpu_dp.resilience.retry import io_retry_params, retry_call
+
+    retries, base_delay = io_retry_params()
+    return retry_call(fn, retries=retries, base_delay=base_delay,
+                      retry_on=(OSError,), jitter=0.5, describe=describe)
 
 
 def leaf_to_host(x) -> np.ndarray:
@@ -78,15 +230,39 @@ QUARANTINED_MARKER = "quarantined.json"
 def _atomic_write_state(
     ckpt_dir: Path, host_state, meta: dict[str, Any] | None
 ) -> Path:
-    """The one atomic-write protocol (tmp file + rename) for state + meta."""
+    """The one atomic-write protocol (tmp file + rename) for state + meta.
+
+    Every save is stamped with the manifest schema and the integrity
+    block (`_integrity_manifest`) so every later load can prove the bytes
+    it reads are the bytes that were written. Transient write errors are
+    retried on the unified IO budget (`_io_retry`); the storage-fault
+    shim's seams (`_chaos_shim`) sit inside the retried block (a
+    transient injected EIO must be retried like a real one) and after the
+    final rename (``torn``/``bitrot`` defeat per-file atomicity by
+    corrupting a COMMITTED save).
+    """
     ckpt_dir.mkdir(parents=True, exist_ok=True)
     payload = serialization.to_bytes(host_state)
-    tmp = ckpt_dir / (_CKPT_NAME + ".tmp")
-    tmp.write_bytes(payload)
-    os.replace(tmp, ckpt_dir / _CKPT_NAME)
-    meta_tmp = ckpt_dir / (_META_NAME + ".tmp")
-    meta_tmp.write_text(json.dumps(meta or {}, indent=2, default=str))
-    os.replace(meta_tmp, ckpt_dir / _META_NAME)
+    meta_out = dict(meta or {})
+    meta_out["schema"] = CKPT_SCHEMA
+    meta_out["integrity"] = _integrity_manifest(payload, host_state)
+    meta_text = json.dumps(meta_out, indent=2, default=str)
+
+    def _write():
+        shim = _chaos_shim()
+        if shim is not None:
+            shim.on_write(ckpt_dir / _CKPT_NAME)
+        tmp = ckpt_dir / (_CKPT_NAME + ".tmp")
+        tmp.write_bytes(payload)
+        os.replace(tmp, ckpt_dir / _CKPT_NAME)
+        meta_tmp = ckpt_dir / (_META_NAME + ".tmp")
+        meta_tmp.write_text(meta_text)
+        os.replace(meta_tmp, ckpt_dir / _META_NAME)
+
+    _io_retry(_write, describe=f"checkpoint write {ckpt_dir.name}")
+    shim = _chaos_shim()
+    if shim is not None:
+        shim.post_commit(ckpt_dir)
     # A fresh complete write into this dir supersedes any quarantine
     # suspicion on its previous contents: a post-rollback replay re-saves
     # CLEAN state into the same step_<n> dirs (same atomic protocol), and
@@ -273,7 +449,7 @@ def _relayout_residual_leaf(saved: np.ndarray, like: np.ndarray,
 
 
 def load_checkpoint(
-    ckpt_dir: str | os.PathLike, target: TrainState
+    ckpt_dir: str | os.PathLike, target: TrainState, verify: bool = True
 ) -> tuple[TrainState, dict[str, Any]]:
     """Restore a `TrainState` (shaped like `target`) + metadata.
 
@@ -286,21 +462,45 @@ def load_checkpoint(
     restores are exact, world/block-size changes preserve the total
     pending correction, checkpoints predating the codec load with
     zero-initialized residuals.
+
+    Every load schema-checks the manifest (`read_meta` — unknown schemas
+    are a typed `CheckpointSchemaError`) and, unless ``verify=False``,
+    proves the payload against its integrity checksums (`verify_payload`
+    — a mismatch is a typed `CorruptCheckpointError`, the signal the
+    resume paths turn into "mark corrupt, fall back to the next-older
+    candidate"). Pre-checksum saves load with verification skipped and
+    counted.
     """
     ckpt_dir = Path(ckpt_dir)
+    meta = read_meta(ckpt_dir)
     payload = (ckpt_dir / _CKPT_NAME).read_bytes()
+    if verify:
+        verify_payload(payload, meta, ckpt_dir)
     host_target = _to_host(target)
     raw = serialization.msgpack_restore(payload)
     raw = _maybe_reshard_opt_state(raw, host_target)
     raw = _reconcile_residuals(raw, host_target)
     state = serialization.from_state_dict(host_target, raw)
-    meta_path = ckpt_dir / _META_NAME
-    meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
     return state, meta
 
 
 def checkpoint_exists(ckpt_dir: str | os.PathLike) -> bool:
     return (Path(ckpt_dir) / _CKPT_NAME).exists()
+
+
+def missing_save_files(step_dir: str | os.PathLike) -> list[str]:
+    """Required save files absent from ``step_dir``; empty = complete.
+
+    THE definition of save completeness (both renames landed — a torn
+    write, a crash between the two renames, leaves one behind). The
+    manager's own scans (`CheckpointManager.complete_dirs`,
+    `CheckpointManager.latest_dir`) and the resume scan
+    (`tpu_dp.resilience.preempt.find_candidates`) must never disagree on
+    it, so all of them call here.
+    """
+    d = Path(step_dir)
+    return [name for name in (_CKPT_NAME, _META_NAME)
+            if not (d / name).exists()]
 
 
 class CheckpointManager:
@@ -333,7 +533,10 @@ class CheckpointManager:
         self._thread = None
         self._error: BaseException | None = None
 
-    def _step_dirs(self) -> list[Path]:
+    def step_dirs(self) -> list[Path]:
+        """Every ``step_<n>`` dir under the root, oldest→newest, complete
+        or not (`complete_dirs` filters; the resume scan attributes each
+        exclusion)."""
         if not self.ckpt_dir.exists():
             return []
         import re
@@ -343,6 +546,9 @@ class CheckpointManager:
             if p.is_dir() and re.fullmatch(r"step_\d+", p.name)
         ]
         return sorted(dirs, key=lambda p: int(p.name.split("_")[1]))
+
+    # retained for callers of the pre-public name
+    _step_dirs = step_dirs
 
     def wait(self) -> None:
         """Join the in-flight async write; re-raise its failure, if any.
@@ -393,7 +599,7 @@ class CheckpointManager:
             if self.keep > 0:
                 import shutil
 
-                for old in self._step_dirs()[: -self.keep]:
+                for old in self.step_dirs()[: -self.keep]:
                     if old != step_dir:
                         shutil.rmtree(old, ignore_errors=True)
 
@@ -421,10 +627,7 @@ class CheckpointManager:
         excluded here and the elastic-regroup/resume paths fall back to
         the previous complete one (`tpu_dp.resilience.find_latest`).
         """
-        return [
-            d for d in self._step_dirs()
-            if (d / _CKPT_NAME).exists() and (d / _META_NAME).exists()
-        ]
+        return [d for d in self.step_dirs() if not missing_save_files(d)]
 
     def latest_dir(self) -> Path | None:
         """Directory of the newest complete checkpoint, or None."""
@@ -438,8 +641,7 @@ class CheckpointManager:
             # still produce it — torn dir or a zero-byte pointer — and
             # resuming a torn dir would fail the regroup it exists to
             # serve.)
-            if name and (cand / _CKPT_NAME).exists() \
-                    and (cand / _META_NAME).exists():
+            if name and not missing_save_files(cand):
                 return cand
             if name:
                 import logging
@@ -501,7 +703,11 @@ def load_params_only(
     when no target is given or the checkpoint carries none.
     """
     ckpt_dir = Path(ckpt_dir)
+    meta = read_meta(ckpt_dir)  # typed refusal of unknown schemas
     payload = (ckpt_dir / _CKPT_NAME).read_bytes()
+    # Serving restores verify too: a hot swap onto bit-rotted weights
+    # would serve garbage with no error anywhere.
+    verify_payload(payload, meta, ckpt_dir)
     raw = serialization.msgpack_restore(payload)
     if not isinstance(raw, dict) or "params" not in raw:
         raise ValueError(
@@ -521,8 +727,6 @@ def load_params_only(
             _to_host(target_batch_stats), raw.get("batch_stats", {}),
             name="batch_stats",
         )
-    meta_path = ckpt_dir / _META_NAME
-    meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
     return params, batch_stats, meta
 
 

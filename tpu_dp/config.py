@@ -207,9 +207,18 @@ class ResilienceConfig:
     # Bounded exponential backoff for resilient collectives (ResilientRing).
     max_retries: int = 2
     retry_base_delay_s: float = 0.05
-    # Deterministic fault injection spec (testing only; see
-    # tpu_dp/resilience/faultinject.py), e.g. "kill:step=13,rank=1".
+    # Deterministic fault injection spec (testing/chaos only; see
+    # tpu_dp/resilience/faultinject.py), e.g. "kill:step=13,rank=1" or a
+    # ';'-composed schedule "bitrot:step=4;spike:step=8,scale=1e6".
     fault: str = ""
+    # Unified total-backoff budget (seconds) for shared-filesystem IO:
+    # the membership ledger's jittered retries AND checkpoint/snapshot
+    # writes derive their exponential schedule from this one knob
+    # (tpu_dp/resilience/retry.py io_retry_schedule; default reproduces
+    # the historical 0.1+0.2+0.4+0.8+1.6s ledger schedule). Exhaustion
+    # stays typed: ledger writes raise ElasticError, snapshot writes
+    # degrade (snapshot.write_errors) per docs/RESILIENCE.md.
+    io_retry_s: float = 3.1
     # Elastic world size (tpu_dp/resilience/elastic.py, docs/RESILIENCE.md
     # "Elastic world size"): a preempted rank triggers a regroup onto the
     # survivors (shrink the mesh, reshard, re-split the epoch) instead of

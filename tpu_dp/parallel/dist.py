@@ -387,6 +387,21 @@ def elastic_initialize(
     if num_processes == 1:
         st.process_id, st.num_processes = 0, 1
         st.coordinator_address = None
+        # The gloo CPU-collectives choice (auto-enabled for multi-process
+        # CPU meshes) is baked into client creation and needs a live
+        # distributed client — a sole survivor rebuilding backends after
+        # `abandon_distributed` would crash on it. Back to the stock
+        # client; a later grow re-enables it on the next re-bootstrap.
+        try:
+            from jax._src import xla_bridge
+
+            if (not xla_bridge.backends_are_initialized()
+                    and xla_bridge.CPU_COLLECTIVES_IMPLEMENTATION.value
+                    == "gloo"):
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "none")
+        except Exception:
+            logger.debug("cpu collectives reset skipped", exc_info=True)
         # Plain single-process from here on; `shutdown()` must not try to
         # tear down a coordination service that no longer exists.
         _initialized_distributed = False

@@ -108,6 +108,7 @@ from pathlib import Path
 from typing import Any, Callable, Sequence
 
 from tpu_dp.obs.counters import counters as _counters
+from tpu_dp.resilience.faultinject import storage_shim as _storage_shim
 
 logger = logging.getLogger(__name__)
 
@@ -250,21 +251,35 @@ class QuiescePlan:
         )
 
 
-#: bounded, jittered retry schedule for every ledger filesystem touch: a
-#: transient shared-FS error (NFS blip, ESTALE, EIO) must be a retry, not
-#: a spurious rollback regroup. The schedule (0.1+0.2+0.4+0.8+1.6 ≈ 3s
-#: plus jitter) absorbs a real server hiccup, not just a dropped packet;
-#: jitter breaks the stampede of a whole slice retrying the same hiccup
-#: in lockstep; attempts/retries/exhaustions land in the existing
-#: ``retry.*`` obs counters via `retry_call`. Exhaustion raises the typed
-#: `ElasticError` below for WRITES (a silently lost publish would stall
-#: the protocol until its timeout); exhausted READS degrade to "not
-#: readable yet" (None) — every read sits in a protocol-level poll loop
-#: already bounded by ``regroup_timeout_s``, so the poll cadence keeps
-#: retrying for far longer than any in-call schedule could.
-_IO_RETRIES = 5
-_IO_BASE_DELAY_S = 0.1
+#: bounded, jittered retry for every ledger filesystem touch: a transient
+#: shared-FS error (NFS blip, ESTALE, EIO) must be a retry, not a
+#: spurious rollback regroup. The schedule derives from the UNIFIED IO
+#: budget ``resilience.io_retry_s`` (`tpu_dp.resilience.retry.
+#: io_retry_params` — default ≈ 3.1s of backoff, the constants PR 12
+#: hard-coded here) plus jitter; jitter breaks the stampede of a whole
+#: slice retrying the same hiccup in lockstep; attempts/retries/
+#: exhaustions land in the existing ``retry.*`` obs counters via
+#: `retry_call`. Exhaustion raises the typed `ElasticError` below for
+#: WRITES (a silently lost publish would stall the protocol until its
+#: timeout); exhausted READS degrade to "not readable yet" (None) —
+#: every read sits in a protocol-level poll loop already bounded by
+#: ``regroup_timeout_s``, so the poll cadence keeps retrying for far
+#: longer than any in-call schedule could. The module globals below are
+#: test-only overrides (None = derive from the configured budget).
+_IO_RETRIES: int | None = None
+_IO_BASE_DELAY_S: float | None = None
 _IO_JITTER = 0.5
+
+
+def _io_params() -> tuple[int, float]:
+    from tpu_dp.resilience.retry import io_retry_params
+
+    retries, base = io_retry_params()
+    if _IO_RETRIES is not None:
+        retries = _IO_RETRIES
+    if _IO_BASE_DELAY_S is not None:
+        base = _IO_BASE_DELAY_S
+    return retries, base
 
 
 def _ledger_io(fn, describe: str):
@@ -278,6 +293,8 @@ def _ledger_io(fn, describe: str):
     """
     from tpu_dp.resilience.retry import retry_call
 
+    retries, base_delay = _io_params()
+
     def attempt():
         try:
             return fn()
@@ -288,14 +305,14 @@ def _ledger_io(fn, describe: str):
 
     try:
         return retry_call(
-            attempt, retries=_IO_RETRIES, base_delay=_IO_BASE_DELAY_S,
+            attempt, retries=retries, base_delay=base_delay,
             jitter=_IO_JITTER, retry_on=(_RetryableLedgerIO,),
             describe=f"membership-ledger {describe}",
         )
     except _RetryableLedgerIO as e:
         raise ElasticError(
             f"membership-ledger {describe} failed after "
-            f"{_IO_RETRIES + 1} attempts: {e.__cause__}"
+            f"{retries + 1} attempts: {e.__cause__}"
         ) from e.__cause__
 
 
@@ -308,6 +325,9 @@ def _atomic_write_json(path: Path, payload: dict) -> None:
     text = json.dumps(payload, indent=2, default=str)
 
     def write():
+        shim = _storage_shim()
+        if shim is not None:
+            shim.on_write(path)
         tmp = path.with_name(path.name + f".tmp{os.getpid()}")
         tmp.write_text(text)
         os.replace(tmp, path)
@@ -325,6 +345,9 @@ def _exclusive_write_json(path: Path, payload: dict) -> bool:
     text = json.dumps(payload, indent=2, default=str)
 
     def write():
+        shim = _storage_shim()
+        if shim is not None:
+            shim.on_write(path)
         tmp = path.with_name(path.name + f".tmp{os.getpid()}")
         tmp.write_text(text)
         try:
@@ -347,6 +370,9 @@ def _read_json(path: Path) -> dict | None:
     see the `_IO_RETRIES` note on why reads degrade instead of raising)."""
 
     def read():
+        shim = _storage_shim()
+        if shim is not None:
+            shim.on_read(path)  # slowfs: injected per-read latency
         try:
             text = path.read_text()
         except FileNotFoundError:

@@ -43,6 +43,25 @@ the latter so spawned worker processes inherit the plan)::
                              # replica AFTER the step boundary — the
                              # silent-data-corruption twin the
                              # cross-replica audit must catch.
+    ioerr:step=6             # storage faults (tpu_dp/chaos/storage.py):
+    ioerr:step=6,n=2         # armed at the step boundary, applied at the
+    enospc:step=6            # checkpoint/snapshot/ledger IO seams. ioerr
+    torn:step=6              # fails the next n (default 1) writes with a
+    bitrot:step=6            # transient EIO; enospc fails EVERY later
+    slowfs:step=6,ms=100     # write with ENOSPC; torn truncates the next
+                             # committed save's payload AFTER its sibling
+                             # meta rename (defeating per-file atomicity);
+                             # bitrot flips bytes inside the next committed
+                             # payload (the checksum manifest must catch
+                             # it); slowfs adds ms of latency to every
+                             # ledger read (n= bounds how many).
+
+**Composed schedules**: a spec may hold several ``;``-separated clauses —
+``"bitrot:step=4;spike:step=8,scale=1e6"`` — each clause keeping the
+single-fault grammar above and arming/spending independently (one
+:class:`FaultInjector` holds them all). Clauses due at the same boundary
+fire in spec order, except ``kill`` always fires last (it never returns,
+and the other faults at that boundary must land first).
 
 With multi-step windows the host observes step counts only at window
 boundaries, so "at step K" means the first boundary where the global step
@@ -62,11 +81,17 @@ import logging
 import os
 import signal
 import time
+from typing import Sequence
 
 logger = logging.getLogger(__name__)
 
+#: kinds applied through the storage-fault shim (`tpu_dp.chaos.storage`)
+#: at the checkpoint/snapshot/ledger IO seams rather than at the step
+#: boundary itself: `on_step` ARMS them (one-shot, rank-gated like every
+#: other plan); the shim applies them when the next matching IO happens.
+STORAGE_KINDS = ("ioerr", "torn", "bitrot", "slowfs", "enospc")
 _KINDS = ("kill", "preempt", "delay", "drop", "leave", "relaunch",
-          "nan", "spike", "sdc")
+          "nan", "spike", "sdc") + STORAGE_KINDS
 #: kinds the Trainer handles through the guardrail layer rather than
 #: `on_step`: nan/spike ride the sentinel's compiled injection seam
 #: (`train/step._inject_guard_fault`), sdc mutates the host-side params.
@@ -76,18 +101,38 @@ GUARD_KINDS = ("nan", "spike", "sdc")
 KILL_EXIT_CODE = 137
 
 
+def storage_shim():
+    """The chaos storage shim, IFF the chaos package was ever armed.
+
+    THE accessor for every production IO seam (checkpoint writes, ledger
+    IO): one definition, so a change to the arming protocol cannot leave
+    one seam silently un-shimmed — a fault that silently never fires is
+    the worst possible outcome. ``sys.modules`` only: a process that
+    never injected a storage fault never imports `tpu_dp.chaos` at all,
+    and the per-call cost is one dict lookup.
+    """
+    import sys
+
+    mod = sys.modules.get("tpu_dp.chaos.storage")
+    if mod is not None and mod.shim.active:
+        return mod.shim
+    return None
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
-    kind: str          # kill | preempt | delay | drop | leave | relaunch | nan | spike | sdc
+    kind: str          # one of _KINDS
     step: int          # global optimizer step the fault fires at (>=)
     rank: int = -1     # -1: every rank
-    delay_ms: float = 0.0
+    delay_ms: float = 0.0  # delay: sleep; slowfs: per-ledger-read latency
     scale: float = 0.0  # spike: multiplier applied to loss/grads
     leaf: str = ""      # sdc: glob over params leaf paths ("" = first leaf)
+    count: int = 0      # ioerr: writes to fail (default 1); slowfs: reads
+                        # to slow (default 0 = unbounded)
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan | None":
-        """Parse ``kind:key=val,key=val``; empty/None spec → no plan."""
+        """Parse one ``kind:key=val,key=val`` clause; empty spec → None."""
         spec = (spec or "").strip()
         if not spec:
             return None
@@ -101,7 +146,8 @@ class FaultPlan:
         leaf = ""
         for item in filter(None, rest.split(",")):
             key, eq, val = item.partition("=")
-            if not eq or key not in ("step", "rank", "ms", "scale", "leaf"):
+            if not eq or key not in ("step", "rank", "ms", "scale", "leaf",
+                                     "n"):
                 raise ValueError(f"bad fault field {item!r} in {spec!r}")
             if key == "leaf":
                 leaf = val
@@ -118,54 +164,139 @@ class FaultPlan:
             delay_ms=float(fields.get("ms", 0.0)),
             scale=float(fields.get("scale", 0.0)),
             leaf=leaf,
+            count=int(fields.get("n", 1 if kind == "ioerr" else 0)),
         )
+
+    @classmethod
+    def parse_schedule(cls, spec: str) -> "list[FaultPlan]":
+        """Parse a ``;``-separated multi-fault schedule into its plans.
+
+        Empty/whitespace clauses are dropped, so trailing ``;`` and the
+        single-clause grammar both parse; an empty schedule is ``[]``.
+        """
+        out = []
+        for clause in (spec or "").split(";"):
+            plan = cls.parse(clause)
+            if plan is not None:
+                out.append(plan)
+        return out
+
+    def to_spec(self) -> str:
+        """The clause string this plan round-trips through ``parse``."""
+        parts = [f"step={self.step}"]
+        if self.rank >= 0:
+            parts.append(f"rank={self.rank}")
+        if self.delay_ms:
+            parts.append(f"ms={self.delay_ms:g}")
+        if self.scale:
+            parts.append(f"scale={self.scale:g}")
+        if self.leaf:
+            parts.append(f"leaf={self.leaf}")
+        if self.count and not (self.kind == "ioerr" and self.count == 1):
+            parts.append(f"n={self.count}")
+        return f"{self.kind}:{','.join(parts)}"
 
 
 class FaultInjector:
-    """Fires a :class:`FaultPlan` exactly once at its step boundary."""
+    """Fires each of a schedule's :class:`FaultPlan`\\ s exactly once.
 
-    def __init__(self, plan: FaultPlan, rank: int = 0):
-        self.plan = plan
+    Holds ONE plan (the classic single-fault spec) or a composed
+    ``;``-schedule of them; every plan arms and spends independently, so
+    a chaos trial can compose e.g. a ``bitrot:`` against the snapshot a
+    later ``spike:`` rollback will want to restore.
+    """
+
+    def __init__(self, plans: "FaultPlan | Sequence[FaultPlan]",
+                 rank: int = 0):
+        if isinstance(plans, FaultPlan):
+            plans = [plans]
+        self.plans: list[FaultPlan] = list(plans)
+        if not self.plans:
+            raise ValueError("FaultInjector needs at least one FaultPlan")
         self.rank = int(rank)
-        self.fired = False
+        self._fired = [False] * len(self.plans)
         self._drop_armed = False
         #: set by a fired ``leave`` plan; the elastic trainer polls it as a
         #: local departure request (`tpu_dp.resilience.elastic`).
         self.leave_requested = False
 
+    @property
+    def plan(self) -> FaultPlan:
+        """The single-plan accessor (first clause of a composed schedule);
+        multi-plan callers iterate ``plans``/use the kind helpers below."""
+        return self.plans[0]
+
+    @property
+    def fired(self) -> bool:
+        """True once EVERY plan has fired/been spent."""
+        return all(self._fired)
+
+    def fired_kind(self, kind: str) -> bool:
+        """True when any plan of ``kind`` has fired."""
+        return any(f and p.kind == kind
+                   for p, f in zip(self.plans, self._fired))
+
+    def has_kind(self, kind: str) -> bool:
+        return any(p.kind == kind for p in self.plans)
+
+    def kinds(self) -> tuple[str, ...]:
+        return tuple(p.kind for p in self.plans)
+
+    def spend(self, kind: str) -> None:
+        """Mark every plan of ``kind`` fired (e.g. a relaunch consumed by
+        `train.trainer.run_elastic` before the rejoined incarnation)."""
+        for i, p in enumerate(self.plans):
+            if p.kind == kind:
+                self._fired[i] = True
+
     @classmethod
     def from_spec(cls, spec: str, rank: int = 0) -> "FaultInjector | None":
-        """Injector from a spec string (or the TPU_DP_FAULT env fallback)."""
+        """Injector from a (possibly ``;``-composed) spec string, falling
+        back to the TPU_DP_FAULT env so spawned workers inherit the plan."""
         spec = spec or os.environ.get("TPU_DP_FAULT", "")
-        plan = FaultPlan.parse(spec)
-        if plan is None:
+        plans = FaultPlan.parse_schedule(spec)
+        if not plans:
             return None
-        return cls(plan, rank=rank)
+        return cls(plans, rank=rank)
 
-    def _due(self, global_step: int) -> bool:
-        if self.fired:
+    def _due(self, i: int, global_step: int) -> bool:
+        if self._fired[i]:
             return False
-        if self.plan.rank >= 0 and self.plan.rank != self.rank:
+        plan = self.plans[i]
+        if plan.rank >= 0 and plan.rank != self.rank:
             return False
-        return global_step >= self.plan.step
+        return global_step >= plan.step
 
     def on_step(self, global_step: int) -> None:
-        """Trainer hook: fire the plan if its step boundary was reached.
+        """Trainer hook: fire every plan whose step boundary was reached.
 
         ``kill`` never returns (`os._exit` — no atexit, no flushes, the
-        honest simulation of a yanked host). The other kinds return after
-        their side effect.
+        honest simulation of a yanked host), so among plans due at the
+        same boundary it fires LAST: the other faults (a storage arm, a
+        drop, a leave request) must land first or a composed schedule
+        silently loses them. The other kinds return after their side
+        effect.
         """
-        if self.plan.kind in GUARD_KINDS:
-            # nan/spike are compiled into the sentinel step (armed through
-            # `device_fault`), sdc is a host-side params mutation the
-            # Trainer owns — firing them here would be a no-op at best.
-            return
-        if not self._due(global_step):
-            return
-        self.fired = True
-        plan = self.plan
-        if plan.kind == "kill":
+        due = [i for i in range(len(self.plans))
+               if self.plans[i].kind not in GUARD_KINDS
+               and self._due(i, global_step)]
+        due.sort(key=lambda i: self.plans[i].kind == "kill")
+        for i in due:
+            self._fired[i] = True
+            self._fire(self.plans[i], global_step)
+
+    def _fire(self, plan: FaultPlan, global_step: int) -> None:
+        if plan.kind in STORAGE_KINDS:
+            # Armed here, applied by the shim at the next matching
+            # checkpoint/snapshot/ledger IO (tpu_dp/chaos/storage.py).
+            logger.warning(
+                "fault injection: arming storage fault %s on rank %d at "
+                "step %d", plan.kind, self.rank, global_step,
+            )
+            from tpu_dp.chaos.storage import shim
+
+            shim.arm(plan)
+        elif plan.kind == "kill":
             logger.warning(
                 "fault injection: killing rank %d at step %d (exit %d)",
                 self.rank, global_step, KILL_EXIT_CODE,
@@ -210,12 +341,17 @@ class FaultInjector:
         The Trainer folds it into the sentinel's ``guard_in`` (the
         compiled injection seam fires at ``state.step == plan.step``) and
         disarms through `disarm_device` at the first boundary past it.
+        The sentinel seam carries one fault, so composed schedules get at
+        most one device plan armed at a time (earliest-step first).
         """
-        if self.fired or self.plan.kind not in ("nan", "spike"):
+        armed = [self.plans[i] for i in range(len(self.plans))
+                 if not self._fired[i]
+                 and self.plans[i].kind in ("nan", "spike")
+                 and (self.plans[i].rank < 0
+                      or self.plans[i].rank == self.rank)]
+        if not armed:
             return None
-        if self.plan.rank >= 0 and self.plan.rank != self.rank:
-            return None
-        return self.plan
+        return min(armed, key=lambda p: p.step)
 
     def disarm_device(self, global_step: int) -> None:
         """One-shot the device seam: past the fault step, stop arming it
@@ -225,12 +361,14 @@ class FaultInjector:
         the window whose END boundary is host step K+1 — disarming at
         ``>= K`` would strip the seam from the very window that fires it.
         """
-        if self.plan.kind in ("nan", "spike") and global_step > self.plan.step:
-            self.fired = True
+        for i, p in enumerate(self.plans):
+            if p.kind in ("nan", "spike") and global_step > p.step:
+                self._fired[i] = True
 
     def take_sdc(self, global_step: int) -> "FaultPlan | None":
         """Consume a due ``sdc:`` plan (the Trainer flips the param bit)."""
-        if self.plan.kind != "sdc" or not self._due(global_step):
-            return None
-        self.fired = True
-        return self.plan
+        for i, p in enumerate(self.plans):
+            if p.kind == "sdc" and self._due(i, global_step):
+                self._fired[i] = True
+                return p
+        return None

@@ -138,6 +138,40 @@ def _manager_step(step_dir: Path) -> int:
     return int(step_dir.name.split("_")[1])
 
 
+#: (dir, reason) pairs already attributed this process — every
+#: resume/rollback/regroup rescans the whole tree, and re-telling the
+#: same skip per scan would make the counter mean scans×dirs and let a
+#: long elastic run flood the bounded flight ring with duplicates.
+_attributed_skips: set = set()
+
+
+def _skip_candidate(step_dir: Path, reason: str) -> None:
+    """Attribute one skipped resume candidate (satellite: a run that
+    restored from an older-than-expected save must be diagnosable from
+    artifacts alone — counter + flight-recorder event + log, surfaced by
+    ``obsctl timeline``). Once per (dir, reason) per process."""
+    key = (str(step_dir), reason)
+    if key in _attributed_skips:
+        return
+    _attributed_skips.add(key)
+    _counters.inc("ckpt.skipped_candidates")
+    _flightrec.record("ckpt_skipped_candidate", dir=str(step_dir),
+                      reason=reason)
+    logger.warning("resume candidate %s skipped: %s", step_dir, reason)
+
+
+def _quarantine_reason(save_dir: Path) -> str:
+    """The reason recorded in a dir's quarantine marker (or a fallback)."""
+    import json
+
+    try:
+        return json.loads(
+            (save_dir / QUARANTINED_MARKER).read_text()
+        ).get("reason", "unspecified")
+    except (OSError, ValueError):
+        return "unspecified"
+
+
 def find_candidates(ckpt_dir: str | Path,
                     snapshot_dir: str | Path | None = None
                     ) -> list[tuple[Path, int]]:
@@ -145,29 +179,49 @@ def find_candidates(ckpt_dir: str | Path,
 
     ``(dir, global_step)`` pairs ordered newest-step-first (epoch
     checkpoints win ties: same step ⇒ same state, and the epoch layout
-    resumes at a clean epoch start). Partially-written step dirs — the
-    signature of a crash mid-snapshot during preemption — are already
-    excluded (`CheckpointManager.complete_dirs`); callers that find the
-    best candidate unreadable fall back down this list instead of failing
-    the regroup (`resume_latest`). The flat pre-manager layout
-    (``<ckpt_dir>/state.msgpack``) is the last resort — it predates step
-    numbering.
+    resumes at a clean epoch start). Excluded — each exclusion ATTRIBUTED
+    via `_skip_candidate`, never silent:
+
+    - partially-written step dirs (one of the two files missing — the
+      signature of a crash mid-snapshot during preemption);
+    - dirs the guardrail/integrity layers marked untrusted
+      (`QUARANTINED_MARKER`: an SDC finding, or a checksum refusal that
+      already proved the bytes rotten) — resuming a corrupted save
+      "successfully" is the failure mode those layers exist to stop.
+
+    Callers that find the best candidate unreadable fall back down this
+    list instead of failing the regroup (`resume_latest`). The flat
+    pre-manager layout (``<ckpt_dir>/state.msgpack``) is the last resort
+    — it predates step numbering.
     """
     ranked: list[tuple[int, int, Path]] = []  # (step, priority, dir)
     for priority, root in ((1, ckpt_dir), (0, snapshot_dir)):
         if root is None:
             continue
-        for d in ckpt_lib.CheckpointManager(root).complete_dirs():
-            # Saves the guardrail layer marked untrusted after an SDC
-            # finding are not candidates: resuming a corrupted save
-            # "successfully" is the failure mode the audit exists to stop.
+        for d in ckpt_lib.CheckpointManager(root).step_dirs():
+            missing = ckpt_lib.missing_save_files(d)
+            if missing:
+                _skip_candidate(
+                    d, f"incomplete save (missing {', '.join(missing)} — "
+                       f"torn write)")
+                continue
             if (d / QUARANTINED_MARKER).exists():
+                _skip_candidate(d, f"quarantined: {_quarantine_reason(d)}")
                 continue
             ranked.append((_manager_step(d), priority, d))
     out = [(d, step) for step, _, d in
            sorted(ranked, key=lambda c: (c[0], c[1]), reverse=True)]
     if not out and ckpt_lib.checkpoint_exists(ckpt_dir):
-        out.append((Path(ckpt_dir), -1))
+        flat = Path(ckpt_dir)
+        # The flat layout honors the quarantine marker too: a corrupt
+        # flat checkpoint is marked by the self-healing resume loop, and
+        # re-offering it here would hand `_load_rollback_state` the same
+        # rotten dir forever — a sleep-free wedge.
+        if (flat / QUARANTINED_MARKER).exists():
+            _skip_candidate(
+                flat, f"quarantined: {_quarantine_reason(flat)}")
+        else:
+            out.append((flat, -1))
     return out
 
 
@@ -189,10 +243,14 @@ def resume_latest(target, ckpt_dir: str | Path,
     Raises FileNotFoundError when there is nothing to resume from.
 
     Robust to a save corrupted by a dying host (truncated msgpack behind
-    an already-renamed file, unreadable meta): the bad candidate is
-    skipped with a warning and the previous complete one restores instead
-    — an elastic regroup must not fail because the final snapshot of a
-    preempted rank was torn.
+    an already-renamed file, bit-rotted bytes behind a valid parse,
+    unreadable meta): a candidate that fails its checksum manifest
+    (`CorruptCheckpointError`) is MARKED corrupt on disk — the same
+    quarantine marker the SDC audit drops, so no later resume re-trusts
+    it — and the previous complete one restores instead; any other
+    unreadable candidate is skipped with a warning. An elastic regroup
+    must not fail because the final snapshot of a preempted rank was
+    torn.
     """
     found = find_candidates(ckpt_dir, snapshot_dir)
     if not found:
@@ -205,6 +263,15 @@ def resume_latest(target, ckpt_dir: str | Path,
         try:
             state, meta = ckpt_lib.load_checkpoint(source, target)
             return state, meta, source
+        except ckpt_lib.CorruptCheckpointError as e:
+            last_err = e
+            _counters.inc("ckpt.corrupt_candidates")
+            quarantine_save_dir(source, f"checksum refusal: {e}")
+            logger.warning(
+                "resume candidate %s failed checksum verification (%s); "
+                "marked corrupt, falling back to the next-older complete "
+                "save", source, e,
+            )
         except Exception as e:  # torn payload / unreadable meta
             last_err = e
             logger.warning(

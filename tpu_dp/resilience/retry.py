@@ -54,6 +54,49 @@ def backoff_delays(retries: int, base_delay: float = 0.05,
     return [min(max_delay, base_delay * (2.0 ** i)) for i in range(retries)]
 
 
+#: Default total backoff budget for shared-filesystem IO (seconds). The
+#: 0.1+0.2+0.4+0.8+1.6 ≈ 3.1s schedule PR 12 hard-coded into the
+#: membership ledger, now the single `resilience.io_retry_s` knob every
+#: ledger AND checkpoint write derives its schedule from
+#: (`io_retry_schedule`): long enough to absorb a real NFS server hiccup,
+#: short enough that a genuinely dead disk surfaces inside one regroup
+#: timeout.
+DEFAULT_IO_RETRY_S = 3.1
+_IO_BASE_DELAY_S = 0.1
+_io_retry_total_s = DEFAULT_IO_RETRY_S
+
+
+def io_retry_schedule(total_s: float, base_delay: float = _IO_BASE_DELAY_S,
+                      max_delay: float = 2.0) -> tuple[int, float]:
+    """``(retries, base_delay)`` whose exponential backoff sums ≤ total_s.
+
+    Doubling from ``base_delay`` (capped at ``max_delay``) until the next
+    sleep would overrun the budget — so ``total_s=3.1`` reproduces the
+    historical 5-retry/0.1s schedule exactly, and a test passing
+    ``io_retry_s=0.01`` gets a fast single-retry exhaustion.
+    """
+    retries, spent = 0, 0.0
+    while True:
+        nxt = min(max_delay, base_delay * (2.0 ** retries))
+        if spent + nxt > float(total_s) + 1e-9:
+            break
+        spent += nxt
+        retries += 1
+    return max(1, retries), base_delay
+
+
+def configure_io_retry(total_s: float) -> None:
+    """Install the process-wide IO retry budget (``resilience.io_retry_s``,
+    set once by the Trainer; `io_retry_params` serves every consumer)."""
+    global _io_retry_total_s
+    _io_retry_total_s = float(total_s) if total_s > 0 else DEFAULT_IO_RETRY_S
+
+
+def io_retry_params() -> tuple[int, float]:
+    """The configured ``(retries, base_delay)`` for one IO retry loop."""
+    return io_retry_schedule(_io_retry_total_s)
+
+
 def retry_call(
     fn: Callable[..., Any],
     *args: Any,

@@ -46,12 +46,12 @@ class SnapshotManager:
     """
 
     def __init__(self, snap_dir: str | os.PathLike, every_steps: int = 0,
-                 keep: int = 2):
+                 keep: int = 2, async_save: bool = True):
         self.snap_dir = Path(snap_dir)
         self.every_steps = int(every_steps)
         self.keep = int(keep)
         self._mgr = CheckpointManager(self.snap_dir, keep=keep,
-                                      async_save=True)
+                                      async_save=async_save)
         # Two host-buffer slots; _host_copy alternates. Slot discipline:
         # by the time a slot comes around again, the write that used it has
         # been joined by the interleaved save() (which waits for the
@@ -136,11 +136,35 @@ class SnapshotManager:
         meta = dict(meta or {})
         meta.setdefault("kind", "snapshot")
         meta["global_step"] = int(global_step)
-        out = self._mgr.save(state, meta, step=int(global_step),
-                             host_state=host_state)
+        try:
+            out = self._mgr.save(state, meta, step=int(global_step),
+                                 host_state=host_state)
+        except (RuntimeError, OSError) as e:
+            # DEGRADE, don't kill training (docs/RESILIENCE.md "Storage
+            # faults"): a full/flaky disk costs durability, not the run.
+            # The cadence marker is already set, so the next crossing
+            # re-arms a fresh attempt; the failure is loud in the
+            # counters, the log, and the black box. Only a rollback or
+            # quiesce that then finds NO usable candidate raises.
+            self._record_write_error(int(global_step), e)
+            return None
         _counters.inc("snapshot.writes")
         _counters.inc("snapshot.write_s", time.perf_counter() - t0)
         return out
+
+    @staticmethod
+    def _record_write_error(global_step: int, err: BaseException) -> None:
+        from tpu_dp.obs import flightrec
+
+        _counters.inc("snapshot.write_errors")
+        flightrec.record("snapshot_write_error", step=global_step,
+                         error=str(err)[:300])
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "snapshot write at step %d failed (%s) — training continues; "
+            "the cadence re-arms at its next crossing", global_step, err,
+        )
 
     def latest_dir(self) -> Path | None:
         return self._mgr.latest_dir()
@@ -154,7 +178,16 @@ class SnapshotManager:
         _counters.inc("snapshot.wait_s", time.perf_counter() - t0)
 
     def close(self) -> None:
-        self._mgr.close()
+        """Join + teardown; a failed in-flight write DEGRADES here (it is
+        already too late to re-arm a cadence — counting and logging is all
+        teardown can do, and masking a propagating training error with a
+        disk error would be worse). Callers that need the commit
+        guarantee (preemption/quiesce finals) call `wait()` explicitly,
+        which still raises."""
+        try:
+            self._mgr.close()
+        except (RuntimeError, OSError) as e:
+            self._record_write_error(self._last_step, e)
 
     def __enter__(self):
         return self

@@ -108,11 +108,16 @@ class FaultHook(StepHook):
         tr = self.tr
         if tr.fault is None:
             return
-        tr.fault.on_step(tr._host_step)
+        # sdc/disarm BEFORE on_step: a due kill never returns
+        # (`os._exit`), and the composed-schedule contract says every
+        # other fault at that boundary lands first — an `sdc:;kill:`
+        # composition must corrupt the params before the host dies, not
+        # silently lose the corruption.
         plan = tr.fault.take_sdc(tr._host_step)
         if plan is not None:
             tr._inject_sdc(plan)
         tr.fault.disarm_device(tr._host_step)
+        tr.fault.on_step(tr._host_step)
 
 
 class HeartbeatHook(StepHook):
@@ -393,15 +398,20 @@ class GuardHook(StepHook):
         if escalate is not None:
             self._escalate(ev, escalate)
         cfg = tr.cfg.guard
-        # The audit pauses while a finding or a membership transition is
-        # in flight: re-gathering against a peer that is mid-eviction (or
-        # already exited 143) is a read-reset crash, and the post-regroup
-        # world re-baselines anyway (`on_regroup`).
-        quiescing = tr.elastic is not None and (
-            tr.elastic.quiescing or tr._quiesce_plan is not None
-        )
-        if cfg.sdc_every_steps > 0 and not tr._sdc_suspect_active \
-                and not quiescing:
+        # The audit pauses only while a FINDING is in flight
+        # (`_sdc_suspect_active` — symmetric: every rank saw the same
+        # gathered verdict). It must NOT pause on this rank's quiesce
+        # state: quiesce entry is rank-local (a leaver knows before the
+        # rate-limited ledger polls tell its peers), so gating on it
+        # desynchronizes the audit schedule across ranks — one rank
+        # blocks in the audit allgather while the already-quiescing
+        # peers block in the next train step, a permanent wedge (the
+        # chaos harness's SDC-during-grow-handshake trial found it).
+        # A converging quiesce keeps every member stepping to the common
+        # stop threshold, so mid-quiesce audits stay in lockstep; a
+        # gather against an already-departed peer fails loudly and is
+        # deferred to the membership protocol above.
+        if cfg.sdc_every_steps > 0 and not tr._sdc_suspect_active:
             prev = self._sdc_marker if self._sdc_marker >= 0 else 0
             if tr._host_step // cfg.sdc_every_steps > prev // cfg.sdc_every_steps:
                 self._sdc_marker = tr._host_step

@@ -322,28 +322,35 @@ class Trainer:
         )
 
         res = cfg.resilience
+        # The unified shared-filesystem IO retry budget: the membership
+        # ledger AND checkpoint/snapshot writes derive their backoff
+        # schedule from this one knob (tpu_dp/resilience/retry.py).
+        from tpu_dp.resilience.retry import configure_io_retry
+
+        configure_io_retry(res.io_retry_s)
         self.snapshot_dir = res.snapshot_dir or str(
             Path(cfg.train.ckpt_dir) / "snapshots"
         )
         self.snap_mgr = SnapshotManager(
             self.snapshot_dir, every_steps=res.snapshot_every_steps,
-            keep=res.snapshot_keep,
+            keep=res.snapshot_keep, async_save=cfg.train.ckpt_async,
         )
         self.preempt = PreemptionHandler() if res.handle_signals else None
         self.fault = FaultInjector.from_spec(
             res.fault, rank=self.ctx.process_index
         )
-        if self.fault is not None and not self.guard_enabled and (
-            self.fault.plan.kind in ("nan", "spike")
-        ):
-            # The nan/spike injection seam is compiled into the sentinel
-            # step; without the sentinel the fault would silently never
-            # fire — the worst property a deterministic injector can have.
-            raise ValueError(
-                f"TPU_DP_FAULT {self.fault.plan.kind!r} requires "
-                f"guard.enabled=true (the injection seam lives in the "
-                f"sentinel-enabled step program)"
-            )
+        if self.fault is not None and not self.guard_enabled:
+            seam = [k for k in self.fault.kinds() if k in ("nan", "spike")]
+            if seam:
+                # The nan/spike injection seam is compiled into the
+                # sentinel step; without the sentinel the fault would
+                # silently never fire — the worst property a
+                # deterministic injector can have.
+                raise ValueError(
+                    f"TPU_DP_FAULT {seam[0]!r} requires guard.enabled=true "
+                    f"(the injection seam lives in the sentinel-enabled "
+                    f"step program)"
+                )
         # Elastic world size (tpu_dp/resilience/elastic.py): this rank's
         # stable id is its process index at generation start; dense ranks
         # are reassigned per membership epoch, sids never. A JOINER's
@@ -557,7 +564,22 @@ class Trainer:
         resume = dict(record.resume or {})
         snap = resume.get("snapshot_dir")
         if snap:
-            self.state, _ = ckpt_lib.load_checkpoint(Path(snap), self.state)
+            try:
+                self.state, _ = ckpt_lib.load_checkpoint(Path(snap),
+                                                         self.state)
+            except ckpt_lib.CorruptCheckpointError as e:
+                # The agreed snapshot IS the joiner's only legal state
+                # source (its own disk is a retired incarnation's) — a
+                # corrupt one is a typed admission abort, never a silent
+                # restore of different bytes than the incumbents hold.
+                # The incumbents' bounded bootstrap timeout then re-forms
+                # the world without us (`establish_fallback`).
+                from tpu_dp.resilience import ElasticError
+
+                raise ElasticError(
+                    f"elastic join: admitted snapshot {snap} failed "
+                    f"checksum verification — aborting the join ({e})"
+                ) from e
             self.state = self._place_state(self.state)
         else:
             # Nothing on disk at the agreed resume point: the run itself
@@ -859,17 +881,44 @@ class Trainer:
             or Path(self.cfg.train.ckpt_dir) / "quarantine.jsonl"
         )
 
+    def _ckpt_write_error(self, err: BaseException) -> None:
+        """Degrade one failed epoch-checkpoint/export write: loud in the
+        counters, the log and the black box — never fatal to the run
+        (the snapshot cadence and older epoch saves still cover resume;
+        docs/RESILIENCE.md "Storage faults")."""
+        from tpu_dp.obs import flightrec
+
+        _obs_counters.inc("ckpt.write_errors")
+        flightrec.record("ckpt_write_error", step=self._host_step,
+                         error=str(err)[:300])
+        log0("epoch-checkpoint write failed (%s) — training continues; "
+             "resume falls back to the newest earlier complete save", err)
+
     def _take_snapshot(self, epoch: int, steps_done: int,
-                       wait: bool = False) -> None:
+                       wait: bool = False) -> bool:
         """One snapshot + the ``on_snapshot`` hook sweep (cadence,
         preemption final, and elastic quiesce final all route here so
-        every registered hook sees every committed snapshot)."""
+        every registered hook sees every committed snapshot).
+
+        Returns False when the write DEGRADED (disk full/flaky — already
+        logged + counted by the snapshot manager): the hooks never see a
+        snapshot that did not commit, and callers whose protocol depends
+        on the commit (quiesce/preempt finals) get the honest verdict.
+        With ``wait=True`` an async failure surfaces here as False too.
+        """
         meta = self._snapshot_meta(epoch, steps_done)
-        self.snap_mgr.snapshot(self.state, self._host_step, meta)
+        out = self.snap_mgr.snapshot(self.state, self._host_step, meta)
+        if out is None:
+            return False
         if wait:
-            self.snap_mgr.wait()
+            try:
+                self.snap_mgr.wait()
+            except (RuntimeError, OSError) as e:
+                self.snap_mgr._record_write_error(self._host_step, e)
+                return False
         for hook in self._hooks:
             hook.on_snapshot(epoch, steps_done, self._host_step, meta)
+        return True
 
     def _inject_sdc(self, plan) -> None:
         """Apply an ``sdc:`` fault: flip one HIGH bit of the matching
@@ -1320,41 +1369,75 @@ class Trainer:
         host has its own disk, so the resume decision and the restored
         state must come from process 0 (otherwise replicas desync: some
         resume, some start fresh). The newest complete save wins across
-        both layouts (`tpu_dp.resilience.find_latest`), so a run killed
-        mid-epoch resumes from its last step snapshot, not the last epoch
-        boundary.
+        both layouts, through the self-healing `resume_latest` loop — a
+        torn or checksum-corrupt best candidate (the torn:/bitrot: chaos
+        signature: a rank killed right after its snapshot committed, the
+        disk having lied about the commit) is marked and the next-older
+        complete save restores instead; the auto-restart must not die on
+        the very artifact the crash mangled. A tree where EVERY candidate
+        is unreadable degrades to a fresh start — the documented
+        ``--resume=auto`` semantics ("continue when a usable save exists,
+        start fresh otherwise"), loudly.
         """
         cfg = self.cfg
-        from tpu_dp.resilience import find_latest
+        from tpu_dp.resilience import resume_latest
 
-        found = find_latest(cfg.train.ckpt_dir, self.snapshot_dir)
-        resume_dir = found[0] if found is not None else None
-        exists = resume_dir is not None
+        resume_dir = None
         if self.ctx.process_count == 1:
-            if not exists:
+            try:
+                self.state, meta, resume_dir = resume_latest(
+                    self.state, cfg.train.ckpt_dir, self.snapshot_dir
+                )
+            except FileNotFoundError:
                 return
-            self.state, meta = ckpt_lib.load_checkpoint(resume_dir, self.state)
+            except RuntimeError:
+                log0("resume: every candidate unreadable — starting "
+                     "fresh (auto-resume semantics)", exc_info=True)
+                return
             self.start_epoch, self.start_step = self._resume_position(meta)
         else:
             from jax.experimental import multihost_utils
 
-            exists0 = bool(
-                int(multihost_utils.broadcast_one_to_all(np.int32(exists)))
-            )
-            if not exists0:
-                return
             # Host-only checkpoint read; the broadcasts below are outside
             # the gate, reached by every rank.
+            loaded, state = False, self.state
+            pos = np.zeros(2, np.int32)
             if self.ctx.process_index == 0:  # dplint: allow(DP101)
-                state, meta = ckpt_lib.load_checkpoint(resume_dir, self.state)
-                epoch, step = self._resume_position(meta)
-                pos = np.asarray([epoch, step], np.int32)
-            else:
-                state, pos = self.state, np.zeros(2, np.int32)
+                try:
+                    state, meta, resume_dir = resume_latest(
+                        self.state, cfg.train.ckpt_dir, self.snapshot_dir
+                    )
+                    pos = np.asarray(self._resume_position(meta), np.int32)
+                    loaded = True
+                except FileNotFoundError:
+                    pass
+                except RuntimeError:
+                    log0("resume: every candidate unreadable — starting "
+                         "fresh (auto-resume semantics)", exc_info=True)
+            loaded0 = bool(
+                int(multihost_utils.broadcast_one_to_all(np.int32(loaded)))
+            )
+            if not loaded0:
+                return
             host_state = jax.tree_util.tree_map(np.asarray, state)
             self.state = multihost_utils.broadcast_one_to_all(host_state)
             pos = multihost_utils.broadcast_one_to_all(pos)
             self.start_epoch, self.start_step = int(pos[0]), int(pos[1])
+            # Non-writer ranks take rank 0's LITERAL pick, not a local
+            # re-derivation: a candidate rank 0 skipped as transiently
+            # unreadable leaves no quarantine marker behind, so a local
+            # `find_latest` could land on a different dir and install a
+            # different membership-lineage tail (replayed/dropped
+            # samples, cross-rank desync).
+            buf = np.zeros(4096, np.uint8)
+            if self.ctx.process_index == 0:  # dplint: allow(DP101)
+                if resume_dir is not None:
+                    raw = str(resume_dir).encode()[:4096]
+                    buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+            raw = multihost_utils.broadcast_one_to_all(buf)
+            raw = np.asarray(raw, np.uint8).tobytes().rstrip(b"\x00")
+            if self.ctx.process_index != 0:  # dplint: allow(DP101)
+                resume_dir = Path(raw.decode()) if raw else None
         if self.cfg.resilience.elastic:
             self._maybe_resume_into_tail(resume_dir)
         log0("resumed from %s at epoch %d step-in-epoch %d (global step %d)",
@@ -1716,7 +1799,14 @@ class Trainer:
                          done=steps_done)
         log0("preemption: taking final snapshot at epoch %d step %d "
              "(global step %d)", epoch, steps_done, self._host_step)
-        self._take_snapshot(epoch, steps_done, wait=True)
+        if not self._take_snapshot(epoch, steps_done, wait=True):
+            # Degrade, still honor the 143 contract: the final write
+            # failed (full/flaky disk — counted + in the black box), so
+            # the auto-restart resumes from the newest EARLIER complete
+            # save instead; dying with a disk error here would just turn
+            # a bounded work loss into a supervisor-visible failure.
+            log0("preemption: final snapshot FAILED — resume will fall "
+                 "back to the newest earlier complete save")
         try:
             res = self.cfg.resilience
             dist.fault_tolerant_barrier(
@@ -1844,11 +1934,15 @@ class Trainer:
             # pre-publish validation sees the missing snapshot and falls
             # back to a rollback resume.
             try:
-                self._take_snapshot(epoch, done, wait=True)
+                committed = self._take_snapshot(epoch, done, wait=True)
             except Exception:
-                log0("elastic: final snapshot at step %d failed — the "
-                     "regroup will resume from the newest complete one",
+                committed = False
+                log0("elastic: final snapshot fetch at step %d failed",
                      self._host_step, exc_info=True)
+            if not committed:
+                log0("elastic: final snapshot at step %d did not commit — "
+                     "the regroup will resume from the newest complete one",
+                     self._host_step)
         self.elastic.ack_and_await_quiesced(plan)
         self._quiesce_plan = None
         if self.elastic.sid in plan.leavers:
@@ -1961,6 +2055,41 @@ class Trainer:
         return {"epoch": 0, "steps_done": 0, "lineage": [],
                 "global_step": 0, "snapshot_dir": None}
 
+    def _load_rollback_state(self, resume: dict, target
+                             ) -> tuple[Any, dict]:
+        """Restore ``resume["snapshot_dir"]`` with the self-healing
+        corrupt-candidate fallback (docs/RESILIENCE.md "Storage faults").
+
+        A candidate that fails its checksum manifest is MARKED corrupt
+        (the same quarantine marker the SDC audit drops — `find_candidates`
+        then skips it forever, on every rank) and the resume payload is
+        recomputed over the remaining candidates. Deterministic across
+        survivors: everyone reads the same shared tree, refuses the same
+        bytes, and lands on the same next-older save. Returns
+        ``(state_or_None, resume)`` — None state means no usable candidate
+        survived (the caller starts fresh, like an empty disk).
+        """
+        from tpu_dp.resilience import quarantine_save_dir
+
+        while resume.get("snapshot_dir"):
+            source = Path(resume["snapshot_dir"])
+            try:
+                state, _ = ckpt_lib.load_checkpoint(source, target)
+                return state, resume
+            except ckpt_lib.CorruptCheckpointError as e:
+                _obs_counters.inc("ckpt.corrupt_candidates")
+                quarantine_save_dir(source, f"checksum refusal: {e}")
+                from tpu_dp.obs import flightrec
+
+                flightrec.record("ckpt_corrupt_fallback",
+                                 step=self._host_step, dir=str(source),
+                                 leaves=list(e.leaves)[:8])
+                log0("rollback restore: %s failed checksum verification "
+                     "(%s) — marked corrupt, falling back to the "
+                     "next-older complete candidate", source, e)
+                resume = self._rollback_resume()
+        return None, resume
+
     def _execute_guard_rollback(self, sig: _GuardRollback) -> tuple[int, int]:
         """Rewind to the newest complete, non-quarantined save and replay.
 
@@ -1990,12 +2119,11 @@ class Trainer:
         log0("guard: rolling back from step %d — %s", from_step,
              sig.trigger.reason)
         if self.elastic is not None or self.ctx.process_count == 1:
-            resume = self._rollback_resume()
-            if resume.get("snapshot_dir"):
-                self.state, _ = ckpt_lib.load_checkpoint(
-                    Path(resume["snapshot_dir"]), self.state
-                )
-                self.state = self._place_state(self.state)
+            state, resume = self._load_rollback_state(
+                self._rollback_resume(), self.state
+            )
+            if state is not None:
+                self.state = self._place_state(state)
             else:
                 self.state = self._fresh_state()
         else:
@@ -2005,13 +2133,10 @@ class Trainer:
             # so the resume decision AND the restored state come from the
             # save writer (rank 0), like `_maybe_resume`.
             if self.ctx.process_index == 0:  # dplint: allow(DP101)
-                resume = self._rollback_resume()
-                state = self.state
-                if resume.get("snapshot_dir"):
-                    state, _ = ckpt_lib.load_checkpoint(
-                        Path(resume["snapshot_dir"]), self.state
-                    )
-                else:
+                state, resume = self._load_rollback_state(
+                    self._rollback_resume(), self.state
+                )
+                if state is None:
                     state = self._fresh_state()
                 pos = np.asarray([resume["epoch"], resume["steps_done"],
                                   resume["global_step"]], np.int32)
@@ -2240,17 +2365,19 @@ class Trainer:
 
         # Reload through the resharding path: the target carries the NEW
         # world's optimizer layout; `load_checkpoint` relays the saved
-        # opt state onto it value-preserving (docs/PERF.md).
+        # opt state onto it value-preserving (docs/PERF.md). A corrupt
+        # agreed snapshot (checksum refusal) self-heals onto the
+        # next-older complete candidate — every survivor reads the same
+        # shared tree, refuses the same bytes, and recomputes the same
+        # fallback resume, so the regroup stays in lockstep.
         target = self._fresh_state()
-        if resume.get("snapshot_dir"):
-            self.state, _ = ckpt_lib.load_checkpoint(
-                Path(resume["snapshot_dir"]), target
-            )
+        state, resume = self._load_rollback_state(resume, target)
+        if state is not None:
             # The restore yields host numpy; place it under the step's own
             # shardings (a numpy leaf behind a cross-process sharding is
             # rejected at dispatch, and the sharded-update opt state must
             # land distributed, not replicated).
-            self.state = self._place_state(self.state)
+            self.state = self._place_state(state)
         else:
             self.state = target  # nothing on disk: restart from init
         self._host_step = int(resume.get("global_step", 0))
@@ -2639,7 +2766,13 @@ class Trainer:
                             "world": self.ctx.process_count,
                             "members": list(rec.members),
                         }
-                    self.ckpt_mgr.save(self.state, ckpt_meta)
+                    try:
+                        self.ckpt_mgr.save(self.state, ckpt_meta)
+                    except (RuntimeError, OSError) as e:
+                        # Same degrade contract as the snapshot cadence
+                        # (docs/RESILIENCE.md "Storage faults"): a full
+                        # disk costs durability, loudly — never the run.
+                        self._ckpt_write_error(e)
                     every = cfg.train.eval_every_epochs
                     if every and (epoch + 1) % every == 0:
                         ev = self.evaluate()
@@ -2671,28 +2804,27 @@ class Trainer:
                     start_step = 0
         finally:
             # Join any in-flight async write even when training aborts —
-            # the freshest checkpoint is exactly what a crash-restart needs.
-            # If an exception is already propagating, a checkpoint failure
-            # must not replace it: log and let the original surface. On a
-            # clean run, a failed final write must raise (a checkpoint that
-            # silently failed to persist is worse than a crash).
+            # the freshest checkpoint is exactly what a crash-restart
+            # needs. A write failure surfacing here DEGRADES (counted +
+            # logged + in the black box): it must neither mask a
+            # propagating training error nor turn a completed run into a
+            # disk-error exit (docs/RESILIENCE.md "Storage faults").
             import sys
 
-            propagating = sys.exc_info()[0] is not None
             try:
                 self.ckpt_mgr.close()
-            except RuntimeError:
-                if not propagating:
-                    raise
-                log0("checkpoint write failed during abort (original "
-                     "exception propagates)", exc_info=True)
+            except (RuntimeError, OSError) as e:
+                # Degrade (counted, logged, in the black box): the run's
+                # training outcome is already decided here, and replacing
+                # it — or a propagating error — with a disk error would
+                # turn "lost the LAST epoch checkpoint, resume falls back
+                # one save" into a supervisor-visible job failure.
+                self._ckpt_write_error(e)
             try:
                 self.snap_mgr.close()
-            except RuntimeError:
-                if not propagating:
-                    raise
-                log0("snapshot write failed during abort (original "
-                     "exception propagates)", exc_info=True)
+            except (RuntimeError, OSError):
+                log0("snapshot write failed during teardown (degraded)",
+                     exc_info=True)
             if self.preempt is not None:
                 self.preempt.uninstall()
             # The black box, FIRST among the telemetry teardown: every
@@ -2751,8 +2883,12 @@ class Trainer:
         wall = time.perf_counter() - t0
 
         # End-of-training weights export (`cifar_example.py:92-93` analogue).
-        ckpt_lib.save_params(f"{cfg.train.ckpt_dir}/final_params.msgpack",
-                             self.state.params)
+        try:
+            ckpt_lib.save_params(
+                f"{cfg.train.ckpt_dir}/final_params.msgpack",
+                self.state.params)
+        except OSError as e:
+            self._ckpt_write_error(e)
 
         result: dict[str, Any] = {
             "history": history,
@@ -2794,8 +2930,7 @@ def run_elastic(cfg: Config) -> tuple[Trainer, dict[str, Any]]:
         except PreemptedError:
             fault = tr.fault
             if rejoined or not (
-                fault is not None and fault.plan.kind == "relaunch"
-                and fault.fired
+                fault is not None and fault.fired_kind("relaunch")
             ):
                 raise
             rejoined = True
@@ -2808,9 +2943,9 @@ def run_elastic(cfg: Config) -> tuple[Trainer, dict[str, Any]]:
             cfg2.resilience.elastic_join = "always"
             cfg2.train.resume = False
             tr = Trainer(cfg2)
-            if tr.fault is not None and tr.fault.plan.kind == "relaunch":
+            if tr.fault is not None and tr.fault.has_kind("relaunch"):
                 # A TPU_DP_FAULT env spec survives into the rejoined
                 # incarnation (cfg2 cleared only the config field); the
                 # plan already fired once this process — mark it spent so
                 # the rejoined rank does not immediately leave again.
-                tr.fault.fired = True
+                tr.fault.spend("relaunch")
