@@ -230,11 +230,13 @@ def compile_with_flops(jitted, *eg_args):
 
 
 def _make_step(model, opt, mesh, sched, use_pallas, update_sharding,
-               sentinel=False, collective_dtype=None, quant_block=None):
+               sentinel=False, collective_dtype=None, quant_block=None,
+               bucket_mb=0.0):
     """The production per-step program for the requested update mode:
     GSPMD (`make_train_step`) for replicated, explicit-collectives
     `make_train_step_shard_map` for the sharded weight update (optionally
-    with the bf16/int8 compressed wire — `--collective-dtype`).
+    with the bf16/int8 compressed wire — `--collective-dtype` — and/or
+    the bucketed overlap schedule — `--bucket-mb`).
     ``sentinel=True`` builds the guardrail variant (`--guard-overhead`)."""
     from tpu_dp.train import make_train_step, make_train_step_shard_map
 
@@ -244,6 +246,7 @@ def _make_step(model, opt, mesh, sched, use_pallas, update_sharding,
             update_sharding=update_sharding, sentinel=sentinel,
             collective_dtype=collective_dtype or None,
             quant_block_size=quant_block,
+            bucket_mb=bucket_mb,
         )
     return make_train_step(model, opt, mesh, sched,
                            use_pallas_xent=use_pallas, sentinel=sentinel)
@@ -272,6 +275,9 @@ def measure_point(cfg: dict) -> dict:
         SGD, cosine_lr, create_train_state, make_multi_step,
     )
 
+    from tpu_dp.parallel import bucketing as bucketing_mod
+    from tpu_dp.parallel import quant as quant_mod
+
     per_chip = int(cfg["per_chip_batch"])
     window = int(cfg["steps_per_call"])
     measure_steps = int(cfg["measure_steps"])
@@ -280,6 +286,7 @@ def measure_point(cfg: dict) -> dict:
     update_sharding = str(cfg.get("update_sharding", "replicated"))
     collective_dtype = str(cfg.get("collective_dtype", "") or "")
     quant_block = int(cfg.get("quant_block_size", 256))
+    bucket_mb = float(cfg.get("bucket_mb", 0) or 0)
     model_name = cfg.get("model", "resnet18")
     flops_per_image, num_classes = MODEL_SPECS[model_name]
     metric = metric_for(model_name, num_classes)
@@ -306,10 +313,9 @@ def measure_point(cfg: dict) -> dict:
         model, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32), opt
     )
     if collective_dtype in ("int8", "i8"):
-        from tpu_dp.parallel import quant as quant_mod
-
         state = state.replace(residuals=quant_mod.init_residuals(
-            state.params, n_chips, quant_block))
+            state.params, n_chips, quant_block,
+            bucket_bytes=bucketing_mod.parse_bucket_mb(bucket_mb)))
     # Two windows execute (compile+warmup, then measured): schedule horizon
     # covers both so the measured steps run at real cosine LRs.
     sched = cosine_lr(0.4, 2 * measure_steps, 2)
@@ -328,7 +334,8 @@ def measure_point(cfg: dict) -> dict:
                                use_pallas_xent=use_pallas,
                                update_sharding=update_sharding,
                                collective_dtype=collective_dtype or None,
-                               quant_block_size=quant_block)
+                               quant_block_size=quant_block,
+                               bucket_mb=bucket_mb)
         stacked = {
             "image": np.stack([d.images for d in host_pool]),
             "label": np.stack([d.labels for d in host_pool]),
@@ -349,7 +356,8 @@ def measure_point(cfg: dict) -> dict:
         step = _make_step(model, opt, mesh, sched, use_pallas,
                           update_sharding,
                           collective_dtype=collective_dtype,
-                          quant_block=quant_block)
+                          quant_block=quant_block,
+                          bucket_mb=bucket_mb)
         batches = [
             shard_batch({"image": d.images, "label": d.labels}, mesh,
                         spec=batch_sharding(mesh))
@@ -557,10 +565,9 @@ def measure_point(cfg: dict) -> dict:
         # step, plus the codec's measured overflow/clip totals over the
         # fenced latency steps. Present for bf16 too (the byte math is the
         # point of the knob); overflow/clip only exist on the int8 path.
-        from tpu_dp.parallel import quant as quant_mod
-
-        quant_rec = quant_mod.wire_report(state.params, n_chips,
-                                          quant_block)
+        quant_rec = quant_mod.wire_report(
+            state.params, n_chips, quant_block,
+            bucket_bytes=bucketing_mod.parse_bucket_mb(bucket_mb))
         quant_rec["collective_dtype"] = collective_dtype
         if collective_dtype in ("int8", "i8"):
             quant_rec["overflow"] = quant_overflow
@@ -599,10 +606,9 @@ def measure_point(cfg: dict) -> dict:
                 comm_exe.as_text())
             wire_rep = None
             if collective_dtype or update_sharding == "sharded":
-                from tpu_dp.parallel import quant as quant_mod2
-
-                wire_rep = quant_mod2.wire_report(state.params, n_chips,
-                                                  quant_block)
+                wire_rep = quant_mod.wire_report(
+                    state.params, n_chips, quant_block,
+                    bucket_bytes=bucketing_mod.parse_bucket_mb(bucket_mb))
             rep = commprof_mod.breakdown(
                 summary, steps=comm_steps,
                 devices=n_chips if summary.get("source") == "host" else 1,
@@ -625,6 +631,12 @@ def measure_point(cfg: dict) -> dict:
                 "steps": comm_steps,
                 "source": rep["source"],
             }
+            if bucket_mb and wire_rep is not None and "buckets" in wire_rep:
+                # The overlap sweep's per-config layout: K and the
+                # per-bucket wire assignments, from the SAME plan the
+                # compiled schedule derives (docs/PERF.md).
+                comm_rec["bucket_mb"] = bucket_mb
+                comm_rec["buckets"] = len(wire_rep["buckets"])
         except Exception as e:  # never fail a measurement over a report stat
             print(f"bench: comm profile failed ({e!r})", file=sys.stderr)
             comm_rec = {"error": str(e)[:300]}
@@ -675,6 +687,7 @@ def measure_point(cfg: dict) -> dict:
                 "update_sharding": update_sharding,
                 "collective_dtype": collective_dtype,
                 "quant_block_size": quant_block,
+                "bucket_mb": bucket_mb,
             },
         }
         if latency_rec is not None:
@@ -840,6 +853,17 @@ def main() -> None:
     ap.add_argument("--quant-block-size", type=int, default=256,
                     help="scaling-block length of the int8 wire codec "
                          "(train.quant_block_size)")
+    ap.add_argument("--bucket-mb", default="",
+                    help="bucketed overlap-scheduled gradient collectives "
+                         "(train.bucket_mb, docs/PERF.md 'Overlapped "
+                         "collectives'): target MB per gradient bucket; "
+                         "requires --update-sharding sharded. A comma list "
+                         "('0,0.25,1,4') sweeps bucket sizes — one "
+                         "measured point each, --comm-profile forced on — "
+                         "and attaches an 'overlap' block (buckets, "
+                         "comm_ms, exposed_comm_ms, overlap_frac per "
+                         "config) to the emitted record, gateable via the "
+                         "existing obsctl diff comm signals")
     ap.add_argument("--comm-profile", action="store_true",
                     help="capture one jax.profiler window of the measured "
                          "program, parse it (tpu_dp.obs.xplane) and attach "
@@ -894,6 +918,28 @@ def main() -> None:
     if args.collective_dtype and args.update_sharding != "sharded":
         ap.error("--collective-dtype requires --update-sharding sharded "
                  "(the wire format lives on the reduce-scatter)")
+    bucket_sweep = []
+    if args.bucket_mb:
+        try:
+            bucket_sweep = [float(x) for x in args.bucket_mb.split(",")]
+        except ValueError:
+            ap.error(f"--bucket-mb must be a float or comma list of "
+                     f"floats, got {args.bucket_mb!r}")
+        if any(v < 0 for v in bucket_sweep):
+            ap.error("--bucket-mb values must be >= 0")
+        if any(bucket_sweep) and args.update_sharding != "sharded":
+            # 0 arms nothing — only a real bucket size needs the
+            # explicit-collectives path.
+            ap.error("--bucket-mb requires --update-sharding sharded "
+                     "(bucketing restructures the explicit reduce-scatter)")
+        if args.sweep or args.sweep_fused:
+            ap.error("--bucket-mb cannot combine with --sweep/--sweep-fused")
+        if len(bucket_sweep) > 1:
+            # The overlap SWEEP's whole point is the exposed-comm
+            # before/after: without comm attribution the table would
+            # record nothing. A single --bucket-mb value profiles only
+            # if the user asked (the documented contract).
+            args.comm_profile = True
 
     if args._measure is not None:
         emit(measure_point(json.loads(args._measure)))
@@ -975,9 +1021,20 @@ def main() -> None:
             for w in (1, 30)
             for fs, fb in variants
         ]
+    elif len(bucket_sweep) > 1:
+        # The --bucket-mb overlap sweep: one measured point per bucket
+        # size (0 = the monolithic baseline), same batch/window; the
+        # emitted record gains the per-config 'overlap' table.
+        grid = [
+            dict(base, per_chip_batch=args.per_chip_batch,
+                 pallas_xent=False, steps_per_call=args.steps_per_call,
+                 bucket_mb=v)
+            for v in bucket_sweep
+        ]
     else:
         grid = [dict(base, per_chip_batch=args.per_chip_batch,
-                     pallas_xent=False, steps_per_call=args.steps_per_call)]
+                     pallas_xent=False, steps_per_call=args.steps_per_call,
+                     bucket_mb=bucket_sweep[0] if bucket_sweep else 0.0)]
 
     ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     results = []
@@ -993,7 +1050,9 @@ def main() -> None:
                   f"{'+bwd' if cfg.get('fused_bwd') else ''}]"
                   if cfg.get("fused_stages") else "")
                + ("/sharded-update"
-                  if cfg.get("update_sharding") == "sharded" else ""))
+                  if cfg.get("update_sharding") == "sharded" else "")
+               + (f"/bucket{cfg['bucket_mb']}mb"
+                  if cfg.get("bucket_mb") else ""))
         got = (f"{rec['value']} {UNIT}, mfu={rec.get('mfu')}"
                if rec.get("value") else rec.get("error"))
         print(f"bench: [{i + 1}/{len(grid)}] {tag}: {got}", file=sys.stderr)
@@ -1005,7 +1064,36 @@ def main() -> None:
               "error": results[0].get("error", "all points failed")})
         sys.exit(0)
     best = max(good, key=lambda r: r["value"])
-    emit(dict(best, n_points=len(good)))
+    best = dict(best, n_points=len(good))
+    if len(bucket_sweep) > 1:
+        # BENCH 'overlap' block: the bucket-size sweep table (docs/PERF.md
+        # "Overlapped collectives"). Each config's comm numbers come from
+        # its own profiled window; exposed_comm_ms / overlap_frac are the
+        # signals `obsctl diff` already gates, so a live bucketed run can
+        # be held to this record.
+        def _overlap_row(r: dict) -> dict:
+            comm = r.get("comm") or {}
+            failed = "error" in comm or not comm
+            row = {
+                "bucket_mb": r.get("config", {}).get("bucket_mb"),
+                # A failed capture is NOT a monolithic schedule: buckets
+                # defaults to 1 only when the profile succeeded without a
+                # bucket layout (bucket_mb=0); a failed row says so.
+                "buckets": None if failed else comm.get("buckets", 1),
+                "comm_ms": comm.get("comm_ms"),
+                "exposed_comm_ms": comm.get("exposed_comm_ms"),
+                "overlap_frac": comm.get("overlap_frac"),
+                "img_per_sec_per_chip": r.get("value"),
+            }
+            if "error" in comm:
+                row["error"] = comm["error"]
+            return row
+
+        best["overlap"] = {
+            "swept": "bucket_mb",
+            "configs": [_overlap_row(r) for r in results],
+        }
+    emit(best)
 
 
 if __name__ == "__main__":

@@ -168,6 +168,7 @@ def test_shipped_int8_steps_have_quantized_schedule(repo_hlo):
         "train_step[shard_map,sharded,int8]@accum1",
         "multi_step[sharded,int8]@w2",
         "train_step[shard_map,sharded,int8,sentinel]@accum1",
+        "train_step[shard_map,sharded,int8,bucketed]@accum1",
     }
     for name, rec in int8.items():
         counts = rec["counts"]
@@ -184,9 +185,14 @@ def test_shipped_int8_steps_have_quantized_schedule(repo_hlo):
         gather_groups = {op["replica_groups"] for op in by_kind["all-gather"]}
         assert len(groups) == 1 and groups == gather_groups, (
             name, groups, gather_groups)
-        # Small-leaf fallback keeps the uncompressed scatter; no gradient
-        # rides a non-scalar float all-reduce.
-        assert by_kind.get("reduce-scatter"), name
+        # Small-leaf fallback keeps the uncompressed scatter — except in
+        # the bucketed schedule when every bucket clears the quantization
+        # threshold (small leaves compress INSIDE their bucket, which is
+        # the bucketed world's point; the recorded layout says which).
+        buckets = rec.get("buckets")
+        expect_rs = (any(b["wire"] != "int8" for b in buckets)
+                     if buckets is not None else True)
+        assert bool(by_kind.get("reduce-scatter")) == expect_rs, name
         non_scalar_ar = [op for op in by_kind.get("all-reduce", [])
                          if "[]" not in op["shape"]]
         assert non_scalar_ar == [], (name, non_scalar_ar)
@@ -202,6 +208,11 @@ def test_shipped_int8_steps_have_quantized_schedule(repo_hlo):
     progs = artifact["programs"]
     assert (progs["train_step[shard_map,sharded,int8]@accum1"]["digest"]
             != progs["train_step[shard_map,sharded]@accum1"]["digest"])
+    # ... and so is the bucket layout: a rank whose train.bucket_mb
+    # diverged compiles a different ordered schedule.
+    assert (progs["train_step[shard_map,sharded,int8,bucketed]@accum1"]
+            ["digest"]
+            != progs["train_step[shard_map,sharded,int8]@accum1"]["digest"])
 
 
 def test_no_int8_wire_ops_outside_opted_in_programs(repo_hlo):

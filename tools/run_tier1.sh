@@ -92,6 +92,24 @@
 #                                    # tampered stream (the live-alert
 #                                    # gate proof), then the -m commprof
 #                                    # tests.
+#   tools/run_tier1.sh --overlap    # bucketed-overlap lane (docs/PERF.md
+#                                    # "Overlapped collectives"): a
+#                                    # profiled 10-step sharded smoke with
+#                                    # train.bucket_mb armed (int8 wire,
+#                                    # K=2 buckets on Net) — exit-coded
+#                                    # checks that the commprof window
+#                                    # reconciles exactly K bucketed
+#                                    # exchanges per step (per the
+#                                    # fingerprint schedule), that the
+#                                    # per-bucket wire bytes are
+#                                    # byte-exact vs quant.wire_report,
+#                                    # and that obs.overlap_frac /
+#                                    # obs.goodput published; a TAMPERED
+#                                    # single-bucket baseline (fabricated
+#                                    # near-zero exposed comm) must make
+#                                    # `obsctl diff` exit 1. Archives
+#                                    # artifacts/overlap_report.json,
+#                                    # then the -m overlap tests.
 #   tools/run_tier1.sh --quant      # quantized-collectives lane: an int8
 #                                    # BENCH point on the 8-device CPU
 #                                    # mesh with exit-coded quant-block
@@ -426,6 +444,115 @@ PY
     rm -rf "$SMOKE"
     echo "commprof lane: artifacts/comm_report.json"
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m commprof \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--overlap" ]; then
+    # Bucketed-overlap lane (docs/PERF.md "Overlapped collectives"): the
+    # acceptance bar of the train.bucket_mb schedule — the capture window
+    # must reconcile exactly K bucketed exchanges per step against the
+    # DP304 fingerprint schedule, the per-bucket wire bytes must be
+    # byte-exact vs quant.wire_report, obs.overlap_frac must publish, and
+    # the diff gate must TRIP against a tampered single-bucket baseline.
+    mkdir -p artifacts
+    SMOKE=$(mktemp -d /tmp/tpu_dp_overlap_smoke.XXXXXX) || exit 1
+    env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python train.py \
+        --data.dataset=synthetic --data.synthetic_train_size=80 \
+        --data.synthetic_test_size=16 --data.batch_size=8 \
+        --data.device_resident=off \
+        --train.epochs=1 --train.log_every=5 --train.eval_at_end=false \
+        --train.steps_per_call=1 --train.obs=full \
+        --train.update_sharding=sharded --train.collective_dtype=int8 \
+        --train.bucket_mb=0.05 \
+        --train.ckpt_dir="$SMOKE/ck" \
+        --obs.comm_profile_steps=4:6 || exit $?
+    env JAX_PLATFORMS=cpu python - "$SMOKE" <<'LANEPY' || exit 1
+import json, subprocess, sys
+from pathlib import Path
+
+import numpy as np
+
+smoke = Path(sys.argv[1])
+rep = json.loads((smoke / "ck/obs/comm_report.json").read_text())
+assert rep["schema"] == 1, rep["schema"]
+recon = rep["reconciliation"]
+assert recon["ok"], recon
+
+# K from the SAME plan the compiled schedule derives (the single source
+# of truth): each quantizing bucket is one int8-payload all-to-all + one
+# f32-scales all-to-all per step; plain buckets one reduce-scatter.
+import jax
+from tpu_dp.models import build_model
+from tpu_dp.parallel import bucketing
+from tpu_dp.train import SGD, create_train_state, shard_optimizer
+model = build_model("net")
+state = create_train_state(model, jax.random.PRNGKey(0),
+                           np.zeros((1, 32, 32, 3), np.float32),
+                           shard_optimizer(SGD(momentum=0.9), 8))
+plan = bucketing.plan_for_tree(state.params, 8,
+                               bucketing.parse_bucket_mb(0.05),
+                               block_size=256, int8=True)
+K = len(plan)
+assert K > 1, f"bucket plan collapsed to {K} bucket(s) — no overlap to prove"
+exp_a2a = 2 * sum(1 for b in plan if b.quantizes)
+exp_rs = sum(1 for b in plan if not b.quantizes)
+got_a2a = recon["by_kind"].get("all-to-all", {}).get("per_step_observed", 0)
+got_rs = recon["by_kind"].get("reduce-scatter", {}).get("per_step_observed", 0)
+assert got_a2a == exp_a2a, (got_a2a, exp_a2a)
+assert got_rs == exp_rs, (got_rs, exp_rs)
+for kind, blk in recon["by_kind"].items():
+    assert blk["ok"], (kind, blk)
+# Per-bucket wire bytes byte-exact vs the codec's own accounting.
+wire = rep["wire"]["reconciliation"]
+assert wire["ok"] and wire["dtype"] == "int8", rep["wire"]
+assert rep["overlap_frac"] is not None and rep["comm_ms"] > 0, rep
+# The input-side half: obs.goodput and obs.overlap_frac both published.
+recs = [json.loads(l) for l in
+        (smoke / "ck/metrics.jsonl").read_text().splitlines()]
+counters = [r.get("counters", {}) for r in recs if r.get("counters")]
+assert any("obs.goodput" in c for c in counters), "obs.goodput never published"
+assert any("obs.overlap_frac" in c for c in counters), \
+    "obs.overlap_frac never published"
+
+# The gate proof: a TAMPERED single-bucket baseline claiming near-zero
+# exposed comm must make `obsctl diff` exit 1 — otherwise the overlap
+# numbers are decorative, not gating.
+rc0 = subprocess.run(
+    [sys.executable, "-m", "tpu_dp.obs", "diff", str(smoke / "ck"),
+     "--write-baseline", str(smoke / "base.json")]).returncode
+assert rc0 == 0, f"clean self-baseline diff must exit 0, got {rc0}"
+base = json.loads((smoke / "base.json").read_text())
+base["exposed_comm_ms"] = max(1e-6, base["exposed_comm_ms"] / 100.0)
+base["overlap_frac"] = 0.999
+(smoke / "tampered_base.json").write_text(json.dumps(base))
+rc = subprocess.run(
+    [sys.executable, "-m", "tpu_dp.obs", "diff", str(smoke / "ck"),
+     "--baseline", str(smoke / "tampered_base.json")],
+    capture_output=True, text=True).returncode
+assert rc == 1, f"tampered single-bucket baseline must exit 1, got {rc}"
+
+Path("artifacts/overlap_report.json").write_text(json.dumps({
+    "ok": True,
+    "buckets": K,
+    "per_step_all_to_all": got_a2a,
+    "per_step_reduce_scatter": got_rs,
+    "comm_ms": rep["comm_ms"],
+    "exposed_comm_ms": rep["exposed_comm_ms"],
+    "overlap_frac": rep["overlap_frac"],
+    "wire_reconciled": wire["ok"],
+    "diff_tampered_exit": rc,
+    "comm_report": rep,
+}, indent=2) + "\n")
+print("overlap smoke:", json.dumps({
+    "buckets": K, "overlap_frac": rep["overlap_frac"],
+    "reconciled": recon["ok"], "diff_tampered_exit": rc,
+}))
+LANEPY
+    rm -rf "$SMOKE"
+    echo "overlap lane: artifacts/overlap_report.json"
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m overlap \
         -p no:cacheprovider
 fi
 

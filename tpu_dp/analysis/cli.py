@@ -215,17 +215,24 @@ def main(argv: list[str] | None = None) -> int:
 
                 # Every legal update schedule: the replicated gradient
                 # pmean, the sharded reduce-scatter path
-                # (train.update_sharding), and the quantized int8 wire
+                # (train.update_sharding), the quantized int8 wire
                 # (train.collective_dtype=int8 — the payload all_to_all is
-                # the counted reduction) each carry the exactly-one-
-                # reduction-per-leaf contract.
+                # the counted reduction), and the bucketed overlap
+                # schedule (train.bucket_mb — each leaf reduces inside its
+                # bucket's concatenated exchange) each carry the
+                # exactly-one-reduction-per-leaf contract.
                 for accum in accum_variants:
-                    for mode, wire in (("replicated", None),
-                                       ("sharded", None),
-                                       ("sharded", "int8")):
+                    for mode, wire, bucket in (
+                        ("replicated", None, 0.0),
+                        ("sharded", None, 0.0),
+                        ("sharded", "int8", 0.0),
+                        ("sharded", None, 0.05),
+                        ("sharded", "int8", 0.05),
+                    ):
                         got, _ = gradsync.verify_repo_step(
                             accum_steps=accum, world=args.world,
                             update_sharding=mode, collective_dtype=wire,
+                            bucket_mb=bucket,
                         )
                         findings.extend(got)
             for f in files:

@@ -282,6 +282,7 @@ def verify_repo_step(
     update_sharding: str = "replicated",
     collective_dtype: str | None = None,
     quant_block_size: int | None = None,
+    bucket_mb: float = 0.0,
     **model_kwargs,
 ) -> tuple[list[Finding], dict[str, int]]:
     """Verify the shipped train step's gradient-sync contract.
@@ -305,6 +306,16 @@ def verify_repo_step(
     `reduce_scatter` — still exactly one data-axis reduction per leaf.
     The traced state carries the per-replica view of the error-feedback
     residuals (`quant.local_residuals`), like the opt-state shards.
+
+    ``bucket_mb > 0`` verifies the bucketed overlap schedule
+    (`train.bucket_mb`): each leaf's gradient now reduces inside its
+    bucket's concatenated exchange, and the backward slice of each
+    parameter output must still contain exactly ONE data-axis reduction —
+    a leaf reduced in two buckets (or bucketed AND monolithically) is the
+    same DP202 double-averaging bug, just better hidden. The
+    `optimization_barrier` token chain that pins issue order deliberately
+    couples buckets through their *inputs* only, so it never drags a
+    neighbouring bucket's collective onto a foreign leaf's slice.
 
     Models constructed with ``axis_name`` (sync-BN) perform in-forward
     data-axis collectives whose AD transposes land on the gradient path,
@@ -343,11 +354,14 @@ def verify_repo_step(
             opt_state=optimizer.local_view(state.opt_state)
         )
     if collective_dtype in ("int8", "i8"):
-        from tpu_dp.parallel import quant
+        from tpu_dp.parallel import bucketing, quant
 
         block = quant_block_size or quant.DEFAULT_BLOCK_SIZE
         state = state.replace(residuals=quant.local_residuals(
-            quant.init_residuals(state.params, world, block), world
+            quant.init_residuals(
+                state.params, world, block,
+                bucket_bytes=bucketing.parse_bucket_mb(bucket_mb),
+            ), world
         ))
     local_step = make_local_step(
         model, optimizer, constant_lr(0.1),
@@ -356,15 +370,17 @@ def verify_repo_step(
         update_sharding=update_sharding,
         collective_dtype=collective_dtype,
         quant_block_size=quant_block_size,
+        bucket_mb=bucket_mb,
     )
     wire = f", collective_dtype={collective_dtype!r}" \
         if collective_dtype else ""
+    buck = f", bucket_mb={bucket_mb}" if bucket_mb else ""
     return verify_local_step(
         local_step,
         (state, _example_batch(accum_steps, batch_size)),
         axis=DATA_AXIS, world=world,
         label=f"make_local_step(model={model_name!r}, "
               f"accum_steps={accum_steps}, "
-              f"update_sharding={update_sharding!r}{wire})",
+              f"update_sharding={update_sharding!r}{wire}{buck})",
         exact=exact,
     )

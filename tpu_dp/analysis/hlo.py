@@ -215,6 +215,103 @@ def lower_and_compile(jitted: Callable, args: Sequence[Any]):
     return compiled.as_text(), stats, caught
 
 
+def _shape_elements(shape: str) -> int:
+    """Element count of an HLO result shape string ('f32[8,16]' -> 128).
+
+    Tuple shapes sum their parts — the CPU backend's all-to-all returns a
+    tuple of per-replica slices ('(s8[1,64],s8[1,64],...)'), whose total
+    IS the exchanged payload."""
+    total = 0
+    for _, dims in re.findall(r"([a-z]+\d*)\[([\d,]*)\]", shape):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n
+    return total
+
+
+def bucket_expectations(plan, world: int, block_size: int) -> list[dict]:
+    """The grad-exchange ops a bucketed program must compile, per bucket.
+
+    Derived from the SAME `bucketing.plan_buckets` plan the step factory,
+    the residual init, and the wire report use — the single source of
+    truth that makes DP301's exactly-once check meaningful. Per bucket:
+
+    - plain (f32/bf16) bucket → one ``reduce-scatter`` whose result holds
+      the bucket's concatenated shard (Σ per-leaf shard elements);
+    - quantizing bucket → one int8-payload ``all-to-all`` of
+      ``world * cpad`` elements plus one f32-scales ``all-to-all`` of
+      ``world * cpad / block`` elements, ``cpad`` the block-padded chunk.
+    """
+    out = []
+    for b in plan:
+        if b.quantizes:
+            qpad = b.quant_padded(world, block_size)
+            out.append({
+                "index": b.index, "wire": "int8",
+                "payload_elements": qpad,
+                "scale_elements": qpad // block_size,
+            })
+        else:
+            out.append({
+                "index": b.index, "wire": "f32",
+                "shard_elements": b.shard_elements(world),
+            })
+    return out
+
+
+def _check_bucket_schedule(collectives: list[HloOp],
+                           bucket_layout: Sequence[dict],
+                           emit) -> None:
+    """DP301, bucketed mode: K bucketed reductions, exactly-once over the
+    union of gradient leaves.
+
+    Matches the compiled module's gradient-exchange ops against the
+    declared per-bucket expectations as multisets of element counts: a
+    missing entry is a DROPPED bucket (those leaves' gradients never
+    reduce — silent replica divergence), an extra one a DUPLICATED /
+    stray exchange (double-averaged gradients or a leaf reduced in two
+    buckets). The params all-gather and the metric scalars are not part
+    of the exchange and are classified by the surrounding sharded-mode
+    checks as before.
+    """
+    from collections import Counter
+
+    observed = Counter()
+    for op in collectives:
+        if op.kind == "reduce-scatter":
+            observed[("reduce-scatter", _shape_elements(op.shape))] += 1
+        elif op.kind == "all-to-all":
+            k = "all-to-all[s8]" if "s8[" in op.shape else "all-to-all[f32]"
+            observed[(k, _shape_elements(op.shape))] += 1
+    expected = Counter()
+    for b in bucket_layout:
+        if b.get("wire") == "int8":
+            expected[("all-to-all[s8]", int(b["payload_elements"]))] += 1
+            expected[("all-to-all[f32]", int(b["scale_elements"]))] += 1
+        else:
+            expected[("reduce-scatter", int(b["shard_elements"]))] += 1
+    missing = expected - observed
+    extra = observed - expected
+    for (kind, elems), n in sorted(missing.items()):
+        emit("DP301",
+             f"bucketed schedule is MISSING {n}x `{kind}` of {elems} "
+             f"elements — a declared gradient bucket was dropped from the "
+             f"compiled exchange, so its leaves' gradients never reduce "
+             f"over the data axis (silent replica divergence); "
+             f"expected {len(bucket_layout)} bucketed reductions covering "
+             f"the union of gradient leaves exactly once")
+    for (kind, elems), n in sorted(extra.items()):
+        emit("DP301",
+             f"bucketed schedule has {n} EXTRA `{kind}` of {elems} "
+             f"elements beyond the declared bucket plan — a duplicated "
+             f"bucket or a leaf exchanged twice double-averages those "
+             f"gradients (the same DP202 rescaling bug at the compiled "
+             f"level), or the compiler re-combined buckets against the "
+             f"issue-order hints")
+
+
 def analyze_module(
     text: str,
     *,
@@ -228,6 +325,7 @@ def analyze_module(
     donation_warnings: Sequence[str] = (),
     update_sharding: str = "replicated",
     wire: str = "f32",
+    bucket_layout: Sequence[dict] | None = None,
 ) -> tuple[list[Finding], dict]:
     """Run DP301–DP304 over one compiled module's text.
 
@@ -403,6 +501,12 @@ def analyze_module(
              f"{metric_reductions} metric reduction(s) declared — an "
              f"undeclared scalar sync per step serializes the schedule")
 
+    # -- DP301, bucketed overlap schedule (train.bucket_mb) --------------
+    if bucket_layout is not None:
+        if not sharded:
+            raise ValueError("bucket_layout applies to sharded-mode programs")
+        _check_bucket_schedule(collectives, bucket_layout, emit)
+
     # -- DP302: host transfers in the hot loop ---------------------------
     for op in ops:
         if op.kind in _HOST_KINDS:
@@ -456,6 +560,13 @@ def analyze_module(
         "wire": wire,
         "collectives": [op.to_dict() for op in collectives],
         "counts": count_collectives(text),
+        # The bucketed overlap schedule's layout (None for monolithic
+        # programs): the per-bucket exchange expectations DP301 verified,
+        # so the fingerprint artifact round-trips the bucket plan and a
+        # reviewer diffing it sees K and the per-bucket element counts,
+        # not just a changed digest.
+        "buckets": (list(bucket_layout) if bucket_layout is not None
+                    else None),
         # Mode-neutral name: in sharded mode the gradient-reduction ops are
         # the reduce-scatter group, not non-scalar all-reduces.
         "grad_reduce_ops": len(grad_ars),
@@ -540,7 +651,7 @@ def shipped_programs(
     path = _step_py_path()
 
     def spec(factory, donated, metrics, grad, mode="replicated",
-             wire="f32"):
+             wire="f32", bucket_layout=None):
         return {
             "donated_leaves": donated,
             "metric_reductions": metrics,
@@ -549,6 +660,7 @@ def shipped_programs(
             "world": world,
             "update_sharding": mode,
             "wire": wire,
+            "bucket_layout": bucket_layout,
         }
 
     for accum in accum_steps:
@@ -597,6 +709,59 @@ def shipped_programs(
             spec(step_mod.make_train_step_shard_map, n_int8_state, 4, True,
                  mode="sharded", wire="int8"),
         )
+    # The bucketed overlap schedule (train.bucket_mb, docs/PERF.md
+    # "Overlapped collectives"): the FOURTH legal world — the sharded
+    # exchange issued as K size-targeted bucket reductions in reverse
+    # production order. The spec carries the bucket layout (derived from
+    # the SAME `bucketing.plan_buckets` plan the step factory compiles),
+    # so DP301 holds the module to "K bucketed reductions, exactly-once
+    # over the union of gradient leaves" per wire dtype, and the DP304
+    # artifact round-trips the layout. 0.05 MB targets K=2 on Net — small
+    # enough that a dropped/duplicated bucket is a real two-sided check.
+    from tpu_dp.parallel import bucketing
+
+    bucket_mb = 0.05
+    bucket_bytes = bucketing.parse_bucket_mb(bucket_mb)
+    block = quant_mod.DEFAULT_BLOCK_SIZE
+    plan_f32 = bucketing.plan_for_tree(state.params, world, bucket_bytes)
+    plan_int8 = bucketing.plan_for_tree(state.params, world, bucket_bytes,
+                                        block_size=block, int8=True)
+    bucket_int8_state = sharded_state.replace(
+        residuals=quant_mod.init_residuals(
+            sharded_state.params, world, block, bucket_bytes=bucket_bytes)
+    )
+    n_bucket_state = len(jax.tree_util.tree_leaves(bucket_int8_state))
+    yield (
+        "train_step[shard_map,sharded,bucketed]@accum1",
+        step_mod.make_train_step_shard_map(
+            model, sharded_opt, mesh, sched, update_sharding="sharded",
+            bucket_mb=bucket_mb,
+        ),
+        (sharded_state, _example_batch(batch)),
+        spec(step_mod.make_train_step_shard_map, n_state, 2, True,
+             mode="sharded",
+             bucket_layout=bucket_expectations(plan_f32, world, block)),
+    )
+    yield (
+        "train_step[shard_map,sharded,int8,bucketed]@accum1",
+        step_mod.make_train_step_shard_map(
+            model, sharded_opt, mesh, sched, update_sharding="sharded",
+            collective_dtype="int8", bucket_mb=bucket_mb,
+        ),
+        (bucket_int8_state, _example_batch(batch)),
+        spec(step_mod.make_train_step_shard_map, n_bucket_state, 4, True,
+             mode="sharded", wire="int8",
+             bucket_layout=bucket_expectations(plan_int8, world, block)),
+    )
+    yield (
+        "multi_step[sharded,bucketed]@w2",
+        step_mod.make_multi_step(model, sharded_opt, mesh, sched,
+                                 num_steps=2, update_sharding="sharded",
+                                 bucket_mb=bucket_mb),
+        (sharded_state, _example_batch(batch, (2,))),
+        spec(step_mod.make_multi_step, n_state, 2, True, mode="sharded",
+             bucket_layout=bucket_expectations(plan_f32, world, block)),
+    )
     yield (
         "multi_step@w2",
         step_mod.make_multi_step(model, opt, mesh, sched, num_steps=2),
@@ -737,6 +902,7 @@ def verify_repo_hlo(
             donation_warnings=donation_warns,
             update_sharding=spec.get("update_sharding", "replicated"),
             wire=spec.get("wire", "f32"),
+            bucket_layout=spec.get("bucket_layout"),
         )
         findings.extend(got)
         record.update(stats)
@@ -850,5 +1016,6 @@ def verify_hlo_hook(path: str, module: Any, world: int) -> list[Finding]:
         donation_warnings=donation_warns,
         update_sharding=str(decl.get("update_sharding", "replicated")),
         wire=str(decl.get("wire", "f32")),
+        bucket_layout=decl.get("bucket_layout"),
     )
     return findings

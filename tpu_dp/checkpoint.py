@@ -370,7 +370,7 @@ def _reconcile_residuals(raw: Any, host_target: TrainState) -> Any:
       dropped;
     - **same layout** → exact round trip (the kill+resume contract);
     - **world size or block size changed** → *pending-correction-
-      preserving* reshard (`_relayout_residual_leaf`): the sum of every
+      preserving* reshard: the sum of every
       replica's pending error is remapped from the old per-chunk layout
       into replica 0's row of the new layout, zeros elsewhere — the total
       un-transmitted correction Σ_r residual_r is exactly what error
@@ -378,7 +378,17 @@ def _reconcile_residuals(raw: Any, host_target: TrainState) -> Any:
       its first post-restore step;
     - **the quantizable-leaf set changed** (block size crossing a leaf's
       threshold): keys the target lacks are dropped, keys it gained start
-      at zero.
+      at zero;
+    - **the bucket layout changed** (`train.bucket_mb` turned on/off or
+      retuned, docs/PERF.md "Overlapped collectives") → *bucket-exact*
+      reshard: residual keys are self-describing leaf compositions
+      (`bucketing.composition` — a per-leaf key is the single-leaf case),
+      so every saved key not passed through bitwise is DECOMPOSED into
+      per-params-leaf pending corrections (`quant.decompose_residual`)
+      and the target's keys are COMPOSED back from that pool
+      (`quant.compose_residual`, debt on replica 0's row) — a leaf moving
+      between buckets, splitting out of one, or merging into another
+      carries its pending correction along exactly.
     """
     if not isinstance(raw, dict):
         return raw
@@ -399,53 +409,45 @@ def _reconcile_residuals(raw: Any, host_target: TrainState) -> Any:
             node = node[part]
         return int(np.asarray(node).size)
 
+    from tpu_dp.parallel import bucketing, quant
+
     out = {}
+    remap_targets = []
+    consumed_keys = set()
     for key, like in target_res.items():
         like = np.asarray(like)
         saved = saved_res.get(key)
-        n = _leaf_elements(key)
-        if saved is None or n is None:
-            out[key] = np.zeros(like.shape, like.dtype)
+        if saved is not None and np.asarray(saved).shape == like.shape:
+            # Same key, same layout: exact round trip (the kill+resume
+            # bitwise contract) — bucketed keys included.
+            out[key] = np.asarray(saved).astype(like.dtype)
+            consumed_keys.add(key)
             continue
-        out[key] = _relayout_residual_leaf(np.asarray(saved), like, n)
+        remap_targets.append((key, like))
+    if remap_targets:
+        # Pending-correction pool: every saved residual NOT passed through
+        # bitwise decomposes into per-leaf debt vectors. A params leaf
+        # lives in exactly one composition per layout, so nothing double-
+        # counts: a leaf whose saved bucket survived bitwise is not in the
+        # pool, and its target key was already emitted above.
+        leaf_sizes: dict[str, int] = {}
+        for key in list(saved_res) + [k for k, _ in remap_targets]:
+            for lk in bucketing.composition(key):
+                if lk not in leaf_sizes:
+                    n = _leaf_elements(lk)
+                    if n is not None:
+                        leaf_sizes[lk] = n
+        pending: dict[str, np.ndarray] = {}
+        for key, saved in saved_res.items():
+            if key in consumed_keys:
+                continue
+            pending.update(quant.decompose_residual(saved, leaf_sizes, key))
+        for key, like in remap_targets:
+            out[key] = quant.compose_residual(pending, like, leaf_sizes,
+                                              key)
     raw = dict(raw)
     raw["residuals"] = out
     return raw
-
-
-def _relayout_residual_leaf(saved: np.ndarray, like: np.ndarray,
-                            n: int) -> np.ndarray:
-    """Reshard one residual leaf onto ``like``'s ``[world, qpad]`` layout.
-
-    ``n`` is the true element count of the matching params leaf (both
-    layouts pad per 1/world chunk — `collectives.psum_scatter_quant`'s
-    layout discipline — so the remap goes through the unpadded leaf
-    order). Same shape passes through bitwise; otherwise the rows are
-    summed (the total pending correction), un-padded chunk-wise from the
-    old world's layout, re-padded into the new world's, and assigned to
-    replica 0's row.
-    """
-    from tpu_dp.parallel.collectives import shard_size
-
-    if saved.shape == like.shape:
-        return saved.astype(like.dtype)
-    if saved.ndim != 2 or like.ndim != 2:
-        return np.zeros(like.shape, like.dtype)
-    w_old = saved.shape[0]
-    cpad_old = saved.shape[1] // max(1, w_old)
-    pchunk_old = shard_size(n, w_old)
-    pending = saved.sum(axis=0).reshape(w_old, cpad_old)[:, :pchunk_old]
-    pending = pending.reshape(-1)[:n]
-    w_new = like.shape[0]
-    cpad_new = like.shape[1] // max(1, w_new)
-    pchunk_new = shard_size(n, w_new)
-    rows = np.zeros((w_new, cpad_new), like.dtype)
-    padded = np.zeros(w_new * pchunk_new, like.dtype)
-    padded[:n] = pending
-    rows[:, :pchunk_new] = padded.reshape(w_new, pchunk_new)
-    out = np.zeros(like.shape, like.dtype)
-    out[0] = rows.reshape(-1)
-    return out
 
 
 def load_checkpoint(

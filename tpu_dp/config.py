@@ -53,6 +53,14 @@ class DataConfig:
     # drop_remainder holds; "on"/"off" force it.
     device_resident: str = "auto"  # auto | on | off
     resident_max_bytes: int = 512 * 1024 * 1024
+    # Per-batch host sync after device placement (debugging/measurement
+    # escape hatch — the before-world of the async double-buffered feed).
+    # Default off: `jax.device_put` is dispatch-only and the pipeline
+    # keeps the next batch's placement in flight while the current one is
+    # consumed, so the h2d copy overlaps the step (docs/PERF.md). True
+    # blocks on every placed batch — the honest comparator the
+    # `data_wait`-shrinks test measures against.
+    sync_placement: bool = False
 
 
 @dataclass
@@ -114,6 +122,16 @@ class TrainConfig:
     # many elements. Smaller blocks track outliers tighter (better
     # accuracy) at more scale overhead on the wire; 256 ≈ 1.6% overhead.
     quant_block_size: int = 256
+    # Bucketed, overlap-scheduled gradient collectives (sharded mode only;
+    # docs/PERF.md "Overlapped collectives"): target MB of f32 gradient
+    # payload per bucket. Leaves are bucketed in reverse production order
+    # and each bucket's reduce-scatter (f32/bf16/int8 wire alike) issues
+    # as soon as its gradients are produced, so XLA's latency-hiding
+    # scheduler can overlap wire time with the remaining backward compute
+    # (the reference DDP's ~25 MB gradient-hook buckets). 0 = off — the
+    # historical single monolithic reduction. Error-feedback residuals
+    # become per-bucket; dplint DP301 verifies the K-bucket schedule.
+    bucket_mb: float = 0.0
     # Runtime telemetry (tpu_dp/obs/, docs/OBSERVABILITY.md). "off": the
     # hot loop is exactly the untelemetered path (benched within noise,
     # HLO identical). "basic": per-step data_wait/dispatch spans, counter

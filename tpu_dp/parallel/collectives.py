@@ -229,6 +229,200 @@ def psum_scatter_quant(
     return shards, new_residuals, {"overflow": overflow, "clip": clip}
 
 
+def _issue_barrier(payload, token):
+    """Pin a bucket's issue order with `jax.lax.optimization_barrier`.
+
+    The scheduling hint of the bucketed schedule (docs/PERF.md "Overlapped
+    collectives"): each bucket's wire payload is coupled to a scalar token
+    carried from the PREVIOUS bucket's barrier, so (a) the buckets'
+    collectives keep their reverse-production issue order — the first
+    bucket's reduction can start while backward still computes the earlier
+    layers — and (b) the optimizer passes (CSE, fusion, collective
+    combining) cannot glob the per-bucket payloads back into one monolithic
+    exchange across the barrier. The token rides the barrier's *input*
+    side only: bucket i+1's issue never waits on bucket i's *completion*,
+    so XLA's latency-hiding scheduler stays free to keep several exchanges
+    in flight while it interleaves the remaining backward compute.
+    """
+    if hasattr(lax, "optimization_barrier"):
+        return lax.optimization_barrier((payload, token))
+    return payload, token  # ancient JAX: hint unavailable, semantics equal
+
+
+def _bucket_rows(leaves, world: int, wire_dtype) -> jnp.ndarray:
+    """Concatenate a bucket's leaves into the world-chunked wire layout.
+
+    Each leaf is flat-padded to a multiple of ``world`` and viewed as
+    [world, pchunk]; concatenating along dim 1 keeps chunk c of the result
+    equal to the concatenation of every leaf's chunk c — so after a tiled
+    reduce-scatter of the flattened rows, replica i's row splits back into
+    exactly the per-leaf shards `shard_slice` pairs with the param shards
+    (the layout contract of the sharded optimizer, unchanged by bucketing).
+    """
+    return jnp.concatenate(
+        [_flat_padded(x, world).astype(wire_dtype).reshape(world, -1)
+         for x in leaves],
+        axis=1,
+    )
+
+
+def _split_bucket_shard(shard, bucket, leaves, world: int, mean: bool,
+                        out: dict) -> None:
+    """Split one bucket's reduced row back into per-leaf flat shards."""
+    off = 0
+    for key, x in zip(bucket.keys, leaves):
+        pchunk = shard_size(x.size, world)
+        seg = shard[off:off + pchunk].astype(x.dtype)
+        if mean:
+            seg = seg / world
+        out[key] = seg
+        off += pchunk
+
+
+def psum_scatter_bucketed(
+    tree: Any,
+    axis_name: str = DATA_AXIS,
+    *,
+    world: int,
+    mean: bool = False,
+    dtype: Any = None,
+    bucket_bytes: int,
+) -> Any:
+    """`psum_scatter` issued as K size-targeted bucket reductions.
+
+    The overlap schedule (`train.bucket_mb`, docs/PERF.md "Overlapped
+    collectives"): leaves are planned into buckets in reverse production
+    order (`bucketing.plan_buckets` — the single source of truth shared
+    with the analyzer and the wire report), each bucket's leaves are
+    concatenated in the world-chunked layout (`_bucket_rows`) and reduced
+    by ONE tiled reduce-scatter, with `optimization_barrier` token
+    chaining pinning the issue order so XLA can hide each bucket's wire
+    time under the remaining backward compute. Per-leaf output layout is
+    identical to `psum_scatter`'s (same flat shards, same padding), and
+    the per-element reduction arithmetic is unchanged — on the same
+    backend the bucketed f32 result is bitwise the unbucketed one
+    (pinned by tests/test_overlap.py; the documented contract is the
+    reduction-order tolerance of docs/PERF.md in case a backend's
+    combined kernel sums differently).
+
+    ``dtype`` compresses the wire exactly like `psum_scatter` (bf16 cast
+    per bucket payload); leaves of mixed dtypes reduce in f32 (the wire
+    layout concatenates, so a common accumulation dtype is required —
+    gradients are f32 everywhere in this repo).
+    """
+    from tpu_dp.parallel import bucketing, quant
+
+    leaves_wp = jax.tree_util.tree_leaves_with_path(tree)
+    by_key = {quant.leaf_key(p): x for p, x in leaves_wp}
+    plan = bucketing.plan_for_tree(tree, world, bucket_bytes)
+    wire_dt = dtype if dtype is not None else jnp.float32
+    out: dict = {}
+    token = jnp.zeros((), jnp.float32)
+    for bucket in plan:
+        leaves = [by_key[k] for k in bucket.keys]
+        rows = _bucket_rows(leaves, world, wire_dt)
+        rows, token = _issue_barrier(rows, token)
+        shard = lax.psum_scatter(
+            rows.reshape(-1), axis_name, scatter_dimension=0, tiled=True
+        ).astype(jnp.float32)
+        _split_bucket_shard(shard, bucket, leaves, world, mean, out)
+    return jax.tree_util.tree_map_with_path(
+        lambda p, x: out[quant.leaf_key(p)], tree
+    )
+
+
+def psum_scatter_quant_bucketed(
+    tree: Any,
+    residuals: dict,
+    axis_name: str = DATA_AXIS,
+    *,
+    world: int,
+    mean: bool = False,
+    block_size: int | None = None,
+    error_feedback: bool = True,
+    bucket_bytes: int,
+) -> tuple[Any, dict, dict]:
+    """`psum_scatter_quant` issued as K bucket exchanges.
+
+    Same codec math as the monolithic path — quantize once, ONE int8
+    all-to-all + f32 scales, dequantize-and-sum once — applied per
+    *bucket*: each quantizing bucket's leaves concatenate into the
+    world-chunked layout, block-pad at the tail of each chunk, and carry
+    ONE error-feedback residual keyed by the bucket's composition key
+    (`bucketing.GradBucket.key` — self-describing, so checkpoint restore
+    can reshard pending corrections bucket-exact across bucket-size or
+    world changes, `checkpoint._reconcile_residuals`). Buckets below the
+    quantization threshold ride the plain f32 reduce-scatter and carry
+    no residual — note the threshold is per bucket, so the small leaves
+    (biases, norm scales) that always took the f32 fallback alone now
+    compress inside their bucket. Issue order and anti-combining hints
+    as in `psum_scatter_bucketed`.
+    """
+    from tpu_dp.parallel import bucketing, quant
+
+    if block_size is None:
+        block_size = quant.DEFAULT_BLOCK_SIZE
+    leaves_wp = jax.tree_util.tree_leaves_with_path(tree)
+    by_key = {quant.leaf_key(p): x for p, x in leaves_wp}
+    plan = bucketing.plan_for_tree(tree, world, bucket_bytes,
+                                   block_size=block_size, int8=True)
+    overflow = jnp.zeros((), jnp.int32)
+    clip = jnp.zeros((), jnp.int32)
+    new_residuals = dict(residuals)
+    out: dict = {}
+    token = jnp.zeros((), jnp.float32)
+    for bucket in plan:
+        leaves = [by_key[k] for k in bucket.keys]
+        rows = _bucket_rows(leaves, world, jnp.float32)
+        rows, token = _issue_barrier(rows, token)
+        if not bucket.quantizes:
+            shard = lax.psum_scatter(
+                rows.reshape(-1), axis_name, scatter_dimension=0, tiled=True
+            )
+            _split_bucket_shard(shard, bucket, leaves, world, mean, out)
+            continue
+        bkey = bucket.key
+        if bkey not in residuals:
+            raise ValueError(
+                f"bucketed int8 exchange found no residual for bucket "
+                f"{bkey!r} — the residual dict's layout does not match "
+                f"the bucket plan (initialize with quant.init_residuals("
+                f"..., bucket_bytes=...) at the SAME bucket_bytes/"
+                f"block_size, or restore through the Trainer so "
+                f"checkpoint._reconcile_residuals reshards it)"
+            )
+        res = residuals[bkey].reshape(-1)  # per-replica row -> flat [qpad]
+        qpad = res.shape[0]
+        schunk = rows.shape[1]             # Σ per-leaf pchunk
+        cpad = qpad // world               # block-aligned chunk length
+        rows = jnp.pad(rows, ((0, 0), (0, cpad - schunk)))
+        eff = rows.reshape(-1)
+        if error_feedback:
+            eff = eff + res
+        q, scales = quant.quantize_blocks(eff, block_size)
+        if error_feedback:
+            deq_local = quant.dequantize_blocks(q, scales, block_size)
+            new_residuals[bkey] = (eff - deq_local).reshape(1, qpad)
+        ov, cl = quant.block_stats(q, scales)
+        overflow, clip = overflow + ov, clip + cl
+        qx = lax.all_to_all(
+            q.reshape(world, cpad), axis_name,
+            split_axis=0, concat_axis=0, tiled=True,
+        )
+        sx = lax.all_to_all(
+            scales.reshape(world, cpad // block_size), axis_name,
+            split_axis=0, concat_axis=0, tiled=True,
+        )
+        deq = (qx.reshape(world, cpad // block_size, block_size)
+               .astype(jnp.float32) * sx[..., None])
+        shard = jnp.sum(deq, axis=0).reshape(cpad)[:schunk]
+        _split_bucket_shard(shard, bucket, leaves, world, mean, out)
+    shards = jax.tree_util.tree_map_with_path(
+        lambda p, x: out[quant.leaf_key(p)], tree
+    )
+    return shards, new_residuals, {"overflow": overflow, "clip": clip}
+
+
 def shard_slice(tree: Any, axis_name: str = DATA_AXIS, *, world: int) -> Any:
     """This replica's 1/world flat shard of every (replicated) leaf.
 

@@ -221,7 +221,8 @@ def block_stats(q, scales):
 # --------------------------------------------------------------------------
 
 def init_residuals(params, world: int,
-                   block_size: int = DEFAULT_BLOCK_SIZE) -> dict:
+                   block_size: int = DEFAULT_BLOCK_SIZE,
+                   bucket_bytes: int = 0) -> dict:
     """Zero-initialized error-feedback residuals for ``params``.
 
     A dict keyed by `leaf_key`, one entry per *quantizable* leaf, each
@@ -231,10 +232,27 @@ def init_residuals(params, world: int,
     that ride the plain wire carry no residual (no entry at all — a
     zero-size leaf would be dropped from XLA's donation aliasing and trip
     DP303).
+
+    ``bucket_bytes > 0`` (the `train.bucket_mb` overlap schedule) makes
+    residuals per-*bucket* instead of per-leaf: one entry per quantizing
+    bucket of `bucketing.plan_for_tree`'s plan, keyed by the bucket's
+    self-describing composition key, shaped ``f32[world, world * cpad]``
+    for the bucket's block-padded chunk length — the layout
+    `collectives.psum_scatter_quant_bucketed` reads and writes.
     """
     import jax
     import jax.numpy as jnp
 
+    if bucket_bytes:
+        from tpu_dp.parallel import bucketing
+
+        plan = bucketing.plan_for_tree(params, world, bucket_bytes,
+                                       block_size=block_size, int8=True)
+        return {
+            b.key: jnp.zeros((world, b.quant_padded(world, block_size)),
+                             jnp.float32)
+            for b in plan if b.quantizes
+        }
     out = {}
     for path, leaf in jax.tree_util.tree_leaves_with_path(params):
         if leaf_quantizes(leaf.size, world, block_size):
@@ -270,7 +288,8 @@ def local_residuals(residuals: dict, world: int) -> dict:
 # --------------------------------------------------------------------------
 
 def wire_report(params, world: int,
-                block_size: int = DEFAULT_BLOCK_SIZE) -> dict:
+                block_size: int = DEFAULT_BLOCK_SIZE,
+                bucket_bytes: int = 0) -> dict:
     """Bytes each wire format puts on the gradient reduce-scatter per step.
 
     Counts the full per-replica payload entering the collective (each
@@ -278,6 +297,16 @@ def wire_report(params, world: int,
     int8 counts payload + f32 scales for quantizable leaves and f32 for
     the small-leaf fallback — the honest compression ratio, not the
     marketing one.
+
+    ``bucket_bytes > 0`` accounts the bucketed overlap schedule
+    (`train.bucket_mb`): f32/bf16 bytes are unchanged (the per-leaf world
+    padding is preserved by concatenation), but int8 block padding and the
+    quantize-vs-fallback decision are per *bucket* — small leaves compress
+    inside their bucket, and the block pad sits once at each bucket
+    chunk's tail. The returned record gains a ``buckets`` layout summary
+    (`bucketing.plan_summary`) — the same plan the compiled schedule, the
+    residual state, and dplint's DP301/DP304 checks derive, which is what
+    keeps `commprof`'s per-bucket wire reconciliation byte-exact.
     """
     import jax
 
@@ -285,19 +314,36 @@ def wire_report(params, world: int,
 
     f32 = bf16 = int8 = 0
     quantized = total = 0
-    for leaf in jax.tree_util.tree_leaves(params):
-        n = leaf.size
-        total += 1
-        pad = padded_size(n, world)
+    buckets_summary = None
+    if bucket_bytes:
+        from tpu_dp.parallel import bucketing
+
+        plan = bucketing.plan_for_tree(params, world, bucket_bytes,
+                                       block_size=block_size, int8=True)
+        buckets_summary = bucketing.plan_summary(plan, world, block_size)
+        # (leaf count, world-padded elements, qpad-or-None) per exchange
+        # group — the unbucketed report is the single-leaf-group case of
+        # the same accounting, so the byte math exists exactly once.
+        groups = [(len(b.keys), b.padded_elements(world),
+                   b.quant_padded(world, block_size) if b.quantizes
+                   else None)
+                  for b in plan]
+    else:
+        groups = [(1, padded_size(leaf.size, world),
+                   quant_padded_size(leaf.size, world, block_size)
+                   if leaf_quantizes(leaf.size, world, block_size)
+                   else None)
+                  for leaf in jax.tree_util.tree_leaves(params)]
+    for leaves, pad, qpad in groups:
+        total += leaves
         f32 += pad * 4
         bf16 += pad * 2
-        if leaf_quantizes(n, world, block_size):
-            quantized += 1
-            qpad = quant_padded_size(n, world, block_size)
+        if qpad is not None:
+            quantized += leaves
             int8 += qpad + (qpad // block_size) * SCALE_BYTES
         else:
             int8 += pad * 4
-    return {
+    out = {
         "block_size": int(block_size),
         "world": int(world),
         "leaves": int(total),
@@ -306,3 +352,93 @@ def wire_report(params, world: int,
                                 "int8": int(int8)},
         "compression_vs_f32": round(f32 / int8, 3) if int8 else None,
     }
+    if buckets_summary is not None:
+        out["bucket_bytes"] = int(bucket_bytes)
+        out["buckets"] = buckets_summary
+    return out
+
+
+# --------------------------------------------------------------------------
+# Residual layout transforms (checkpoint resharding across bucket/world
+# changes — host-side numpy; see `checkpoint._reconcile_residuals`).
+# --------------------------------------------------------------------------
+
+def decompose_residual(saved, leaf_sizes: dict[str, int],
+                       key: str) -> dict[str, "np.ndarray"]:
+    """One saved residual leaf -> per-params-leaf pending corrections.
+
+    ``saved`` is ``f32[w_old, qpad_old]`` in the composition layout of
+    ``key`` (a `bucketing.composition` of one or more leaf keys — a plain
+    per-leaf residual is the single-leaf case). The *sum over replica
+    rows* is the total un-transmitted correction error feedback owes the
+    trajectory; this walks the old world-chunked concat layout and
+    returns it as one f32[n] vector per leaf, in original element order.
+    Leaves whose true size is unknown (absent from ``leaf_sizes``) abort
+    the decomposition — the offsets of everything after them would be
+    guesses — and {} is returned (the pending correction is forfeited,
+    bounded by ONE step's quantization error, exactly like a pre-codec
+    restore).
+    """
+    import numpy as np
+
+    from tpu_dp.parallel import bucketing
+    from tpu_dp.parallel.collectives import shard_size
+
+    saved = np.asarray(saved)
+    keys = bucketing.composition(key)
+    if saved.ndim != 2 or any(k not in leaf_sizes for k in keys):
+        return {}
+    w_old = saved.shape[0]
+    if w_old < 1 or saved.shape[1] % w_old:
+        return {}
+    cpad_old = saved.shape[1] // w_old
+    pchunks = [shard_size(int(leaf_sizes[k]), w_old) for k in keys]
+    if sum(pchunks) > cpad_old:
+        return {}  # not this composition's layout — refuse to misattribute
+    pending = saved.sum(axis=0).reshape(w_old, cpad_old)
+    out: dict = {}
+    off = 0
+    for k, pchunk in zip(keys, pchunks):
+        n = int(leaf_sizes[k])
+        flat = pending[:, off:off + pchunk].reshape(-1)[:n]
+        out[k] = flat.astype(saved.dtype)
+        off += pchunk
+    return out
+
+
+def compose_residual(pending: dict[str, "np.ndarray"], like,
+                     leaf_sizes: dict[str, int], key: str):
+    """Per-leaf pending corrections -> one residual leaf shaped ``like``.
+
+    The inverse of `decompose_residual` for the TARGET layout: each leaf's
+    pending vector is re-padded into the new world-chunked concat layout
+    of ``key``'s composition and the whole debt is assigned to replica 0's
+    row (rows 1..w zero) — replica 0 pays the un-transmitted correction on
+    its first post-restore step, the same contract the per-leaf reshard
+    has always had. Leaves with no pending entry contribute zeros.
+    """
+    import numpy as np
+
+    from tpu_dp.parallel import bucketing
+    from tpu_dp.parallel.collectives import shard_size
+
+    like = np.asarray(like)
+    out = np.zeros(like.shape, like.dtype)
+    keys = bucketing.composition(key)
+    if like.ndim != 2 or like.shape[0] < 1 or like.shape[1] % like.shape[0]:
+        return out
+    w_new = like.shape[0]
+    cpad_new = like.shape[1] // w_new
+    row = np.zeros((w_new, cpad_new), like.dtype)
+    off = 0
+    for k in keys:
+        n = int(leaf_sizes.get(k, 0))
+        pchunk = shard_size(n, w_new)
+        vec = pending.get(k)
+        if vec is not None and n:
+            padded = np.zeros(w_new * pchunk, like.dtype)
+            padded[:n] = np.asarray(vec).reshape(-1)[:n]
+            row[:, off:off + pchunk] = padded.reshape(w_new, pchunk)
+        off += pchunk
+    out[0] = row.reshape(-1)
+    return out

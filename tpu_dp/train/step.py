@@ -613,6 +613,7 @@ def make_multi_step(
     collective_dtype: str | None = None,
     quant_block_size: int | None = None,
     quant_error_feedback: bool = True,
+    bucket_mb: float = 0.0,
     sentinel: bool = False,
 ) -> Callable:
     """Device-side training loop: ``num_steps`` train steps in ONE program.
@@ -670,10 +671,12 @@ def make_multi_step(
             collective_dtype=collective_dtype,
             quant_block_size=quant_block_size,
             quant_error_feedback=quant_error_feedback,
+            bucket_mb=bucket_mb,
             sentinel=sentinel,
         )
     else:
         _check_update_sharding(update_sharding, optimizer)
+        _refuse_replicated_bucketing(bucket_mb)
         body = _select_body(model, optimizer, schedule, loss_impl,
                             augment_fn, accum_steps, sentinel=sentinel)
 
@@ -737,6 +740,7 @@ def make_multi_step_resident(
     collective_dtype: str | None = None,
     quant_block_size: int | None = None,
     quant_error_feedback: bool = True,
+    bucket_mb: float = 0.0,
     sentinel: bool = False,
 ) -> Callable:
     """Windowed training loop fed by a device-resident dataset + indices.
@@ -779,10 +783,12 @@ def make_multi_step_resident(
             collective_dtype=collective_dtype,
             quant_block_size=quant_block_size,
             quant_error_feedback=quant_error_feedback,
+            bucket_mb=bucket_mb,
             sentinel=sentinel,
         )
     else:
         _check_update_sharding(update_sharding, optimizer)
+        _refuse_replicated_bucketing(bucket_mb)
         body = _select_body(model, optimizer, schedule, loss_impl,
                             augment_fn, accum_steps, sentinel=sentinel)
 
@@ -821,6 +827,18 @@ def make_multi_step_resident(
 
 
 UPDATE_SHARDING_MODES = ("replicated", "sharded")
+
+
+def _refuse_replicated_bucketing(bucket_mb: float) -> None:
+    """Bucketing restructures the explicit reduce-scatter schedule; the
+    replicated GSPMD path has no explicit exchange to bucket. Refused at
+    every factory boundary — a silently-dropped `bucket_mb` would leave
+    the caller believing the overlap schedule is armed."""
+    if bucket_mb and float(bucket_mb) > 0:
+        raise ValueError(
+            "bucket_mb applies to the sharded update's reduce-scatter; "
+            "pass update_sharding='sharded'"
+        )
 
 
 def _check_update_sharding(update_sharding: str, optimizer) -> None:
@@ -929,6 +947,7 @@ def make_local_step(
     collective_dtype: str | None = None,
     quant_block_size: int | None = None,
     quant_error_feedback: bool = True,
+    bucket_mb: float = 0.0,
     sentinel: bool = False,
 ) -> Callable:
     """The per-shard step program with *explicit* collectives, unjitted.
@@ -970,11 +989,22 @@ def make_local_step(
     including under gradient accumulation (`accum_steps > 1`, where the
     reduction must sit after the microbatch scan, not inside it).
 
+    ``bucket_mb > 0`` (`train.bucket_mb`, docs/PERF.md "Overlapped
+    collectives") issues the gradient exchange as K size-targeted bucket
+    reductions in reverse production order instead of one monolithic
+    reduce-scatter — `collectives.psum_scatter_bucketed` (f32/bf16 wire)
+    or `psum_scatter_quant_bucketed` (int8, per-bucket error-feedback
+    residuals) — with `optimization_barrier` issue-order hints so XLA's
+    latency-hiding scheduler can overlap each bucket's wire time with the
+    remaining backward compute. Sharded mode only (the overlap schedule
+    IS the decomposed exchange); DP301 verifies the K-bucket schedule
+    covers the union of gradient leaves exactly once.
+
     ``cast_params=False`` skips the varying-cast of the params (a no-op on
     pre-vma JAX anyway); the analyzer uses it to trace outside a real
     `shard_map` scope.
     """
-    from tpu_dp.parallel import collectives, quant
+    from tpu_dp.parallel import bucketing, collectives, quant
     from tpu_dp.parallel.dist import DATA_AXIS
 
     if axis_name is None:
@@ -989,6 +1019,9 @@ def make_local_step(
             "collective_dtype applies to the sharded update's "
             "reduce-scatter; pass update_sharding='sharded'"
         )
+    bucket_bytes = bucketing.parse_bucket_mb(bucket_mb)
+    if update_sharding != "sharded":
+        _refuse_replicated_bucketing(bucket_mb)
 
     loss_impl = _select_loss_impl(use_pallas_xent)
 
@@ -999,14 +1032,24 @@ def make_local_step(
         # replica keeping only the shard its optimizer slice will consume —
         # through the int8 wire codec when configured (quantize once →
         # int8 all-to-all → dequantize once; residuals carry the error
-        # feedback across steps).
+        # feedback across steps), and as K bucketed reductions in reverse
+        # production order when `bucket_mb` arms the overlap schedule.
         extra = {}
         if isinstance(codec, quant.Int8BlockCodec):
-            grads, residuals, stats = collectives.psum_scatter_quant(
-                grads, residuals, axis_name, world=world, mean=True,
-                block_size=codec.block_size,
-                error_feedback=codec.error_feedback,
-            )
+            if bucket_bytes:
+                grads, residuals, stats = (
+                    collectives.psum_scatter_quant_bucketed(
+                        grads, residuals, axis_name, world=world, mean=True,
+                        block_size=codec.block_size,
+                        error_feedback=codec.error_feedback,
+                        bucket_bytes=bucket_bytes,
+                    ))
+            else:
+                grads, residuals, stats = collectives.psum_scatter_quant(
+                    grads, residuals, axis_name, world=world, mean=True,
+                    block_size=codec.block_size,
+                    error_feedback=codec.error_feedback,
+                )
             # Codec-health counts are rank-local (each replica quantizes
             # its own contribution): two scalar psums make them replicated
             # metrics — declared in the analyzer's metric-reduction budget
@@ -1016,6 +1059,12 @@ def make_local_step(
                     stats["overflow"], axis_name),
                 "quant_clip": collectives.psum(stats["clip"], axis_name),
             }
+        elif bucket_bytes:
+            grads = collectives.psum_scatter_bucketed(
+                grads, axis_name, world=world, mean=True,
+                dtype=codec.dtype if codec is not None else None,
+                bucket_bytes=bucket_bytes,
+            )
         elif update_sharding == "sharded":
             grads = collectives.psum_scatter(
                 grads, axis_name, world=world, mean=True,
@@ -1073,6 +1122,7 @@ def make_train_step_shard_map(
     collective_dtype: str | None = None,
     quant_block_size: int | None = None,
     quant_error_feedback: bool = True,
+    bucket_mb: float = 0.0,
     sentinel: bool = False,
 ) -> Callable:
     """Explicit-collectives variant of the DP train step (`shard_map`).
@@ -1124,6 +1174,7 @@ def make_train_step_shard_map(
         update_sharding=update_sharding, collective_dtype=collective_dtype,
         quant_block_size=quant_block_size,
         quant_error_feedback=quant_error_feedback,
+        bucket_mb=bucket_mb,
         sentinel=sentinel,
     )
 

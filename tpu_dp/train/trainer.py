@@ -209,6 +209,18 @@ class Trainer:
                 f"train.quant_block_size must be >= 1, got "
                 f"{cfg.train.quant_block_size}"
             )
+        # Bucketed overlap-scheduled collectives (train.bucket_mb,
+        # docs/PERF.md "Overlapped collectives"): parsed once here so a
+        # bad value fails at config time, threaded into every step
+        # factory, the residual init, and the commprof wire report.
+        from tpu_dp.parallel import bucketing
+
+        self._bucket_bytes = bucketing.parse_bucket_mb(cfg.train.bucket_mb)
+        if self._bucket_bytes and us != "sharded":
+            raise ValueError(
+                "train.bucket_mb applies to the sharded update's "
+                "reduce-scatter; set train.update_sharding=sharded"
+            )
         self._quant_pub_step = -1  # last window whose codec stats published
 
         model_kwargs = dict(
@@ -644,6 +656,7 @@ class Trainer:
         return state.replace(residuals=quant.init_residuals(
             state.params, dist.data_axis_size(self.mesh),
             self.cfg.train.quant_block_size,
+            bucket_bytes=self._bucket_bytes,
         ))
 
     def _fresh_state(self) -> Any:
@@ -720,11 +733,13 @@ class Trainer:
             shuffle=cfg.data.shuffle, seed=cfg.train.seed,
             drop_remainder=cfg.data.drop_remainder, prefetch=cfg.data.prefetch,
             accum_steps=cfg.optim.grad_accum_steps,
+            sync_placement=cfg.data.sync_placement,
         )
         self.test_pipe = DataPipeline(
             self.test_ds, cfg.data.batch_size, self.mesh,
             shuffle=False, seed=cfg.train.seed,
             drop_remainder=False, prefetch=cfg.data.prefetch,
+            sync_placement=cfg.data.sync_placement,
         )
 
     def _build_training(self) -> None:
@@ -774,6 +789,7 @@ class Trainer:
                     update_sharding=us,
                     collective_dtype=cfg.train.collective_dtype or None,
                     quant_block_size=cfg.train.quant_block_size,
+                    bucket_mb=cfg.train.bucket_mb,
                     sentinel=self.guard_enabled,
                 ))
         else:
@@ -812,6 +828,7 @@ class Trainer:
                 update_sharding=us,
                 collective_dtype=cfg.train.collective_dtype or None,
                 quant_block_size=cfg.train.quant_block_size,
+                bucket_mb=cfg.train.bucket_mb,
                 sentinel=self.guard_enabled,
             ))
 
@@ -1148,6 +1165,7 @@ class Trainer:
             wire_report = quant.wire_report(
                 self.state.params, dist.data_axis_size(self.mesh),
                 cfg.train.quant_block_size,
+                bucket_bytes=self._bucket_bytes,
             )
         from tpu_dp.obs import chips
 
@@ -1328,6 +1346,7 @@ class Trainer:
             drop_remainder=True, prefetch=cfg.data.prefetch,
             accum_steps=cfg.optim.grad_accum_steps,
             sampler=ElasticTailSampler(idx, epoch),
+            sync_placement=cfg.data.sync_placement,
         )
         from types import SimpleNamespace
 
@@ -1520,6 +1539,7 @@ class Trainer:
                 update_sharding=self.update_sharding,
                 collective_dtype=self.cfg.train.collective_dtype or None,
                 quant_block_size=self.cfg.train.quant_block_size,
+                bucket_mb=self.cfg.train.bucket_mb,
                 sentinel=self.guard_enabled,
             ))
             self._resident_loops[n] = loop
