@@ -733,6 +733,13 @@ def measure_point(cfg: dict) -> dict:
 # Parent: orchestration, archive, headline emission.
 # --------------------------------------------------------------------------
 
+#: results.jsonl row layout version. 1 (implicit, untagged) = pre-tune
+#: rows; 2 adds the `schema` tag itself and `config_hash` — the stable
+#: join key between archived rows, tune-trial ledger entries, and
+#: tuned.json profiles.
+ARCHIVE_SCHEMA = 2
+
+
 def archive(record: dict) -> None:
     # CPU-backend rows are harness smoke tests (outage-time validation),
     # not measurements of the TPU metric their name carries: tag them so
@@ -740,6 +747,15 @@ def archive(record: dict) -> None:
     # `last_good_archived` independently filters on backend as well.
     if record.get("backend") == "cpu":
         record = dict(record, smoke=True)
+    record.setdefault("schema", ARCHIVE_SCHEMA)
+    if "config_hash" not in record:
+        # Canonical digest of the row's own config block (stdlib-only
+        # import; shared with tpu_dp.tune so trial rows and profiles
+        # hash identical configs identically).
+        from tpu_dp.tune.profile import config_hash
+
+        record = dict(record,
+                      config_hash=config_hash(record.get("config") or {}))
     RESULTS_PATH.parent.mkdir(parents=True, exist_ok=True)
     with open(RESULTS_PATH, "a") as f:
         f.write(json.dumps(record) + "\n")
@@ -767,8 +783,11 @@ def last_good_archived(metric: str = METRIC) -> dict | None:
             continue
         # Metric-less lines predate multi-model support and were all
         # implicitly the resnet18 headline — default them to METRIC so a
-        # resnet50 query can never pick one up.
+        # resnet50 query can never pick one up. Tune-trial rows are
+        # deliberately tiny short-fence measurements archived for
+        # provenance — never a stale headline.
         if (rec.get("value") and rec.get("backend") not in (None, "cpu")
+                and not rec.get("tune_trial")
                 and rec.get("metric", METRIC) == metric):
             good.append(rec)
     if not good:
@@ -910,8 +929,48 @@ def main() -> None:
                     help="wait before the second probe attempt; doubles "
                          "per retry, capped at 120s")
     ap.add_argument("--point-timeout", type=float, default=900.0)
+    ap.add_argument("--profile", default=None,
+                    help="apply a tpu_dp.tune tuned.json: fills the "
+                         "update-sharding / collective-dtype / "
+                         "quant-block-size / bucket-mb knobs (and the "
+                         "model, from the profile key's workload) that "
+                         "were NOT given explicitly — explicit flags win. "
+                         "The profile's (workload, devices, backend) key "
+                         "must match the measured device or bench refuses "
+                         "(exit 2), never silently measuring a different "
+                         "topology under tuned numbers")
     ap.add_argument("--_measure", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
+    profile = None
+    if args.profile is not None:
+        from tpu_dp.tune.profile import (ProfileError,
+                                         ProfileMismatchError,
+                                         check_key, load_profile)
+        try:
+            profile = load_profile(args.profile)
+        except ProfileError as e:
+            ap.error(str(e))
+        explicit = {a.split("=", 1)[0]
+                    for a in sys.argv[1:] if a.startswith("--")}
+        knobs = profile["config"]
+        if "--model" not in explicit:
+            workload = str(profile["key"]["workload"])
+            if workload not in MODEL_SPECS:
+                ap.error(f"profile {args.profile} is keyed for workload "
+                         f"{workload!r}, which this bench cannot measure "
+                         f"(known models: {', '.join(sorted(MODEL_SPECS))})")
+            args.model = workload
+        if ("--update-sharding" not in explicit
+                and "train.update_sharding" in knobs):
+            args.update_sharding = str(knobs["train.update_sharding"])
+        if ("--collective-dtype" not in explicit
+                and "train.collective_dtype" in knobs):
+            args.collective_dtype = str(knobs["train.collective_dtype"])
+        if ("--quant-block-size" not in explicit
+                and "train.quant_block_size" in knobs):
+            args.quant_block_size = int(knobs["train.quant_block_size"])
+        if "--bucket-mb" not in explicit and knobs.get("train.bucket_mb"):
+            args.bucket_mb = str(knobs["train.bucket_mb"])
     if args.sweep and args.sweep_fused:
         ap.error("--sweep and --sweep-fused are mutually exclusive; "
                  "run them as two invocations (both archive)")
@@ -965,6 +1024,16 @@ def main() -> None:
                    f"({info['n_devices']} device(s)) — no TPU plugin/relay "
                    f"in this environment")
         info = None
+    if info is None and profile is not None:
+        # A --profile run is a claim about a SPECIFIC topology; with the
+        # profile's backend absent there is nothing honest to measure —
+        # refuse loudly instead of re-emitting a stale row under tuned
+        # colors (the "typed error, not silent CPU fallback" contract).
+        print(f"bench: --profile {args.profile} is keyed for backend "
+              f"{profile['key'].get('backend')!r} but no usable device "
+              f"was reached ({failure}) — refusing to fall back",
+              file=sys.stderr)
+        sys.exit(2)
     if info is None:
         stale = last_good_archived(hmetric)
         if stale is not None:
@@ -987,6 +1056,16 @@ def main() -> None:
         sys.exit(0)
     print(f"bench: device ok — {info['n_devices']}x {info['device_kind']} "
           f"({info['backend']})", file=sys.stderr)
+    if profile is not None:
+        try:
+            check_key(profile, workload=args.model,
+                      devices=info["n_devices"], backend=info["backend"],
+                      where="this bench run")
+        except ProfileMismatchError as e:
+            print(f"bench: --profile {args.profile}: {e}", file=sys.stderr)
+            sys.exit(2)
+        print(f"bench: profile {args.profile} key ok "
+              f"(config_hash {profile['config_hash']})", file=sys.stderr)
 
     base = {"measure_steps": args.measure_steps, "platform": args.platform,
             "model": args.model, "fused_stages": args.fused_stages,

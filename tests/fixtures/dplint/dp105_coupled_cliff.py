@@ -1,0 +1,37 @@
+"""Adversarial dplint fixture — DP105: coupled bucket/quant knobs
+pinned at a known quality cliff.
+
+`bucket_mb >= 4` with `quant_block_size >= 256` under the int8 codec
+shares coarse absmax scales across many MB of fused gradient payload
+(docs/PERF.md "Bucket-size/block-size coupling") — a convergence cliff
+no throughput number shows. Each knob alone is fine; hardcoding the
+*pair* is what fires. The suppressed twin at the bottom is the
+deliberate-site idiom.
+"""
+
+
+def fast_but_lossy_config() -> dict:
+    return dict(  # EXPECT: DP105
+        bucket_mb=8.0,
+        quant_block_size=512,
+        collective_dtype="int8",
+    )
+
+
+LAUNCH_ARGV = [  # EXPECT: DP105
+    "--train.update_sharding=sharded",
+    "--train.bucket_mb=4",
+    "--train.quant_block_size=256",
+    "--train.collective_dtype=int8",
+]
+
+# Below the cliff on either axis: silent.
+FINE_SMALL_BUCKETS = {"train.bucket_mb": 1.0,
+                      "train.quant_block_size": 512,
+                      "train.collective_dtype": "int8"}
+FINE_BF16 = dict(bucket_mb=8.0, quant_block_size=512,
+                 collective_dtype="bf16")
+
+# A deliberate trip (e.g. a test of the runtime warning) is pragma'd.
+DELIBERATE = dict(bucket_mb=8.0, quant_block_size=512,  # dplint: allow(DP105)
+                  collective_dtype="int8")

@@ -178,13 +178,21 @@ def test_last_good_archived_none_on_missing_or_junk(tmp_path, monkeypatch):
     assert bench.last_good_archived() is None
 
 
-def test_archive_appends(tmp_path, monkeypatch):
+def test_archive_appends_with_schema_and_config_hash(tmp_path, monkeypatch):
+    from tpu_dp.tune.profile import config_hash
+
     p = tmp_path / "nested" / "results.jsonl"
     monkeypatch.setattr(bench, "RESULTS_PATH", p)
     bench.archive({"a": 1})
-    bench.archive({"b": 2})
-    lines = p.read_text().splitlines()
-    assert [json.loads(x) for x in lines] == [{"a": 1}, {"b": 2}]
+    bench.archive({"b": 2, "config": {"bucket_mb": 1.0}})
+    rows = [json.loads(x) for x in p.read_text().splitlines()]
+    assert [r["a" if "a" in r else "b"] for r in rows] == [1, 2]
+    # Every archived row is stamped with the archive schema version and
+    # the canonical digest of its own config block, so trial rows, BENCH
+    # emissions, and tuned.json profiles join on one key.
+    assert [r["schema"] for r in rows] == [bench.ARCHIVE_SCHEMA] * 2
+    assert rows[0]["config_hash"] == config_hash({})
+    assert rows[1]["config_hash"] == config_hash({"bucket_mb": 1.0})
 
 
 def test_run_point_reports_child_failure(monkeypatch):

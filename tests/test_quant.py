@@ -836,7 +836,9 @@ def test_diff_verdict_skips_quant_for_unquantized_runs():
     skipped = {c["signal"] for c in v["checks"]
                if c["verdict"] == "skipped"}
     # The comm-attribution signals follow the same contract: a run that
-    # never profiled a comm window is skipped, never compared as 0.
+    # never profiled a comm window is skipped, never compared as 0 — as
+    # is the throughput headline when neither side measured it.
     assert skipped == {"quant_overflow_per_step",
                        "quant_clip_blocks_per_step",
-                       "comm_ms", "exposed_comm_ms", "overlap_frac"}
+                       "comm_ms", "exposed_comm_ms", "overlap_frac",
+                       "img_per_sec_per_chip"}

@@ -1,0 +1,645 @@
+"""Self-tuning harness suite (`tpu_dp/tune/`, docs/TUNE.md).
+
+Units for every leg of ISSUE 16's tentpole: the search-space grammar
+(aliases, quoting, pinned-only refusals, `auto`), the analytic bucket
+prior's sizing math, deterministic ranking with the exposed-comm
+tie-break, the ledger's cache/resume behavior, and the two acceptance
+properties run end-to-end with a stub trial runner — same seed emits a
+byte-identical ``tuned.json``, and a populated ledger resumes without
+re-running a single trial. The chaos gate is exercised through a stub
+gate here (the planted fast-but-fragile candidate must be rejected with
+receipts); the real subprocess gate runs in `tools/run_tier1.sh --tune`.
+Satellites ride along: the shared coupling guard + dplint DP105, the
+archive's ``schema``/``config_hash`` stamp, and `--profile` precedence
+through the real `parse_cli`.
+
+Everything here is jax-free and subprocess-free — the tune package's
+parsing/driver half is stdlib-only by design.
+"""
+
+import json
+
+import pytest
+
+from tpu_dp.analysis import coupling
+from tpu_dp.config import Config, coupling_warning, parse_cli
+from tpu_dp.obs.objective import (
+    is_tied,
+    objective_value,
+    tiebreak_value,
+    trial_signals,
+)
+from tpu_dp.tune import prior
+from tpu_dp.tune.profile import (
+    PROFILE_SCHEMA,
+    ProfileError,
+    ProfileMismatchError,
+    apply_profile,
+    build_profile,
+    check_key,
+    config_hash,
+    dump_profile,
+    load_profile,
+    make_key,
+)
+from tpu_dp.tune.search import (
+    PLANTED_BLOCK_SIZE,
+    Ledger,
+    rank,
+    run_search,
+)
+from tpu_dp.tune.space import (
+    BUDGETS,
+    DEFAULT_SPACE,
+    EXECUTABLE_KNOBS,
+    SearchSpace,
+    SpaceError,
+    point_label,
+    rung_key,
+)
+from tpu_dp.tune.trial import trial_cfg
+
+pytestmark = pytest.mark.tune
+
+_QUIET = {"log": lambda *a, **k: None}
+
+#: A 4-point executable grid (2 buckets x 2 block sizes, int8 pinned).
+SMALL_SPEC = ("train.update_sharding=sharded;train.bucket_mb=0.0,1.0;"
+              "train.quant_block_size=64,128;train.collective_dtype=int8")
+
+
+def stub_record(knobs):
+    """A deterministic fenced-looking BENCH record: the score and the
+    exposed-comm tie-breaker are pure functions of the knob hash, so two
+    searches over the same grid measure 'the same machine'."""
+    h = int(config_hash(knobs), 16)
+    value = 100.0 + (h % 97)
+    return {
+        "value": value,
+        "goodput": round(value * 0.9, 4),
+        "mfu": 0.41,
+        "n_chips": 8,
+        "backend": "cpu",
+        "device_kind": "cpu",
+        "config": dict(sorted(knobs.items())),
+        "latency": {"p95_ms": 12.5},
+        "comm": {"comm_ms": 30.0,
+                 "exposed_comm_ms": round(1.0 + (h % 13) / 10, 4),
+                 "overlap_frac": 0.8},
+    }
+
+
+class StubRunner:
+    """Counts invocations so the resume test can assert 'zero re-runs'."""
+
+    def __init__(self, record=stub_record):
+        self.calls = []
+        self.record = record
+
+    def __call__(self, knobs, rung):
+        self.calls.append((config_hash(knobs), rung_key(rung)))
+        return self.record(knobs)
+
+
+def search_kwargs(workdir, **over):
+    kw = dict(seed=7, budget="tiny", space=SearchSpace.parse(SMALL_SPEC),
+              workdir=workdir, **_QUIET)
+    kw.update(over)
+    return kw
+
+
+# ---------------------------------------------------------------------------
+# space grammar
+# ---------------------------------------------------------------------------
+
+def test_space_aliases_resolve_to_dotted_paths():
+    space = SearchSpace.parse("bucket_mb=0,1;collective_dtype=int8")
+    assert set(space.knobs) == {"train.bucket_mb",
+                                "train.collective_dtype"}
+    assert space.knobs["train.bucket_mb"] == (0, 1)
+
+
+def test_space_quoted_serve_ladder_is_one_candidate():
+    space = SearchSpace.parse("serve.buckets='1,2,4,8'")
+    assert space.knobs["serve.buckets"] == ("1,2,4,8",)
+
+
+def test_space_unbalanced_quote_refused():
+    with pytest.raises(SpaceError, match="unbalanced quote"):
+        SearchSpace.parse("serve.buckets='1,2")
+
+
+def test_space_pinned_knob_refuses_multiple_candidates():
+    with pytest.raises(SpaceError, match="pinned-only"):
+        SearchSpace.parse("serve.max_wait_ms=1.0,2.0")
+
+
+def test_space_auto_only_on_bucket_mb():
+    with pytest.raises(SpaceError, match="auto"):
+        SearchSpace.parse("quant_block_size=auto")
+
+
+def test_space_unknown_and_duplicate_and_empty_refused():
+    with pytest.raises(SpaceError, match="unknown knob"):
+        SearchSpace.parse("train.nope=1")
+    with pytest.raises(SpaceError, match="twice"):
+        SearchSpace.parse("bucket_mb=1;train.bucket_mb=2")
+    with pytest.raises(SpaceError, match="empty"):
+        SearchSpace.parse("  ;  ")
+    with pytest.raises(SpaceError, match="not knob"):
+        SearchSpace.parse("bucket_mb")
+
+
+def test_space_spec_round_trips():
+    space = SearchSpace.parse(DEFAULT_SPACE)
+    assert space.needs_prior
+    again = SearchSpace.parse(space.spec)
+    assert again.knobs == space.knobs
+
+
+def test_space_enumerate_refuses_unresolved_auto():
+    space = SearchSpace.parse("train.bucket_mb=auto;collective_dtype=int8")
+    with pytest.raises(SpaceError, match="unresolved"):
+        space.enumerate()
+    resolved = space.with_bucket_candidates([0.0, 2.5])
+    grid = resolved.enumerate()
+    assert [g["train.bucket_mb"] for g in grid] == [0.0, 2.5]
+
+
+def test_space_grid_is_full_cartesian_product():
+    grid = SearchSpace.parse(SMALL_SPEC).enumerate()
+    assert len(grid) == 4
+    assert len({config_hash(g) for g in grid}) == 4
+    for g in grid:
+        assert g["train.update_sharding"] == "sharded"
+
+
+def test_point_label_mentions_knobs_and_hash():
+    knobs = {"train.bucket_mb": 1.0, "train.quant_block_size": 64,
+             "train.collective_dtype": "int8"}
+    label = point_label(knobs)
+    assert "bucket1.0" in label and "block64" in label and "int8" in label
+    assert config_hash(knobs) in label
+
+
+def test_budgets_are_escalating_rungs():
+    for name, rungs in BUDGETS.items():
+        steps = [r["measure_steps"] for r in rungs]
+        assert steps == sorted(steps), name
+        assert all(rung_key(r).startswith("m") for r in rungs)
+
+
+# ---------------------------------------------------------------------------
+# the bucket prior
+# ---------------------------------------------------------------------------
+
+def probe_record(comm_ms=30.0, exposed=8.0, payload_mb=44.0):
+    return {"comm": {"comm_ms": comm_ms, "exposed_comm_ms": exposed,
+                     "overlap_frac": 0.7},
+            "grad_payload_mb": payload_mb}
+
+
+def test_prior_sizes_candidates_from_exposed_window():
+    # K* = ceil(30 / (0.25 * 8)) = 15 -> bracket {8, 15, 30} buckets.
+    got = prior.bucket_candidates(probe_record())
+    assert got[0] == 0.0 and len(got) == 4
+    assert got == sorted(got)
+    for mb in got[1:]:
+        k = 44.0 / mb
+        assert prior.MIN_BUCKETS <= round(k) <= prior.MAX_BUCKETS
+
+
+def test_prior_degenerates_to_control_when_nothing_to_reclaim():
+    assert prior.bucket_candidates(
+        probe_record(exposed=0.01)) == [0.0]
+    assert prior.bucket_candidates({"comm": {}}) == [0.0]
+    assert prior.bucket_candidates(
+        probe_record(payload_mb=None)) == [0.0]
+
+
+def test_prior_reads_quant_f32_wire_accounting_first():
+    rec = {"comm": {"comm_ms": 20.0, "exposed_comm_ms": 4.0},
+           "quant": {"wire_bytes_per_step": {"f32": 10 * 2**20}},
+           "grad_payload_mb": 999.0}
+    assert prior.grad_payload_mb(rec) == 10.0
+    info = prior.describe(rec, [0.0, 1.25])
+    assert info["grad_payload_mb"] == 10.0
+    assert info["candidates"] == [0.0, 1.25]
+    assert info["target_exposed_frac"] == prior.TARGET_EXPOSED_FRAC
+
+
+# ---------------------------------------------------------------------------
+# objective + ranking
+# ---------------------------------------------------------------------------
+
+def test_objective_none_for_failed_trial_never_zero():
+    assert objective_value({"error": "boom"}) is None
+    assert objective_value({"value": 12.0}) == 12.0
+    assert objective_value({"goodput": 3.0}, "goodput") == 3.0
+    with pytest.raises(ValueError, match="unknown objective"):
+        objective_value({}, "vibes")
+
+
+def test_tiebreak_missing_comm_ranks_last():
+    assert tiebreak_value({}) == float("inf")
+    assert tiebreak_value({"comm": {"exposed_comm_ms": 1.5}}) == 1.5
+
+
+def _entry(score, tiebreak, tag):
+    return {"knobs": {"train.bucket_mb": tag}, "score": score,
+            "tiebreak": tiebreak,
+            "config_hash": config_hash({"train.bucket_mb": tag}),
+            "record": {}}
+
+
+def test_rank_score_then_tiebreak_then_hash():
+    clear = [_entry(110.0, 9.0, 1), _entry(100.0, 0.1, 2)]
+    assert [e["score"] for e in rank(clear)] == [110.0, 100.0]
+    # Within the 3% tie window the lower exposed-comm number wins even
+    # against the nominally higher score.
+    tied = [_entry(101.0, 2.0, 3), _entry(100.0, 1.0, 4)]
+    assert [e["score"] for e in rank(tied)] == [100.0, 101.0]
+    assert is_tied(100.0, 101.0) and not is_tied(100.0, 110.0)
+
+
+def test_rank_unmeasured_trials_sink():
+    entries = [_entry(None, float("inf"), 5), _entry(50.0, 1.0, 6)]
+    ranked = rank(entries)
+    assert ranked[0]["score"] == 50.0 and ranked[-1]["score"] is None
+
+
+def test_trial_signals_carries_obsctl_units():
+    sig = trial_signals(stub_record({"train.bucket_mb": 0.0}))
+    assert sig["img_per_sec_per_chip"] is not None
+    assert sig["exposed_comm_ms"] is not None
+    assert sig["p95_ms"] == 12.5
+
+
+# ---------------------------------------------------------------------------
+# ledger
+# ---------------------------------------------------------------------------
+
+def test_ledger_caches_and_survives_corrupt_lines(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    led = Ledger(path)
+    knobs = {"train.bucket_mb": 1.0}
+    rec = led.trial(knobs, "m1l2", lambda: {"value": 1.0})
+    assert led.misses == 1 and rec["value"] == 1.0
+    # A crashed writer's torn line must not poison the resume.
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"kind": "trial", "config_hash": TORN\n')
+    led2 = Ledger(path)
+    assert led2.trial(knobs, "m1l2",
+                      lambda: pytest.fail("cache miss")) == rec
+    assert led2.hits == 1 and led2.misses == 0
+
+
+def test_ledger_digest_tracks_file_bytes(tmp_path):
+    led = Ledger(tmp_path / "ledger.jsonl")
+    empty = led.digest()
+    led.trial({"train.bucket_mb": 0.0}, "m1l2", lambda: {"value": 2.0})
+    assert led.digest() != empty
+    assert len(led.digest()) == 12
+
+
+# ---------------------------------------------------------------------------
+# the search driver: determinism + resume (acceptance properties)
+# ---------------------------------------------------------------------------
+
+def test_search_same_seed_same_bytes(tmp_path):
+    profiles = []
+    for run in ("a", "b"):
+        runner = StubRunner()
+        profile = run_search(runner=runner,
+                             **search_kwargs(tmp_path / run))
+        out = tmp_path / f"tuned_{run}.json"
+        dump_profile(profile, out)
+        profiles.append((out.read_bytes(), runner.calls, profile))
+    assert profiles[0][0] == profiles[1][0]
+    assert profiles[0][1] == profiles[1][1]  # identical trial sequence
+    prof = profiles[0][2]
+    assert prof["schema"] == PROFILE_SCHEMA
+    assert prof["provenance"]["trial_sequence"] == [
+        h for h, _ in profiles[0][1]]
+    assert prof["config_hash"] == config_hash(prof["config"])
+    # The key comes from the winner's own fenced record.
+    assert prof["key"] == {"workload": "resnet18", "devices": 8,
+                           "backend": "cpu", "device_kind": "cpu"}
+
+
+def test_search_resume_reruns_nothing(tmp_path):
+    first = StubRunner()
+    profile = run_search(runner=first, **search_kwargs(tmp_path))
+    assert len(first.calls) == 4
+    resumed = StubRunner()
+    again = run_search(runner=resumed, **search_kwargs(tmp_path))
+    assert resumed.calls == []  # every trial served from the ledger
+    assert json.dumps(again, sort_keys=True) == \
+        json.dumps(profile, sort_keys=True)
+
+
+def test_search_different_seed_different_order(tmp_path):
+    orders = {}
+    for seed in (7, 8):
+        runner = StubRunner()
+        run_search(runner=runner,
+                   **search_kwargs(tmp_path / str(seed), seed=seed))
+        orders[seed] = runner.calls
+    assert sorted(orders[7]) == sorted(orders[8])  # same grid...
+    assert orders[7] != orders[8]  # ...different seeded order
+
+
+def test_search_halving_promotes_top_half(tmp_path):
+    runner = StubRunner()
+    run_search(runner=runner,
+               **search_kwargs(tmp_path, budget="small"))
+    rungs = [r for _, r in runner.calls]
+    assert rungs.count("m2l3") == 4  # every point runs the cheap rung
+    assert rungs.count("m6l6") == 2  # top half graduates
+
+
+def test_search_auto_bucket_runs_probe_and_stamps_prior(tmp_path):
+    spec = ("train.update_sharding=sharded;train.bucket_mb=auto;"
+            "train.quant_block_size=64;train.collective_dtype=int8")
+
+    def record(knobs):
+        rec = stub_record(knobs)
+        rec["comm"] = {"comm_ms": 30.0, "exposed_comm_ms": 8.0,
+                       "overlap_frac": 0.7}
+        rec["grad_payload_mb"] = 44.0
+        return rec
+
+    runner = StubRunner(record)
+    profile = run_search(runner=runner,
+                         **search_kwargs(
+                             tmp_path, space=SearchSpace.parse(spec)))
+    info = profile["provenance"]["bucket_prior"]
+    assert info["candidates"][0] == 0.0 and len(info["candidates"]) == 4
+    assert profile["provenance"]["grid_points"] == len(info["candidates"])
+    # Probe first, then one trial per prior-sized candidate.
+    assert len(runner.calls) == 1 + len(info["candidates"])
+
+
+def test_search_all_failed_trials_raise(tmp_path):
+    runner = StubRunner(lambda knobs: {"error": "wedged"})
+    with pytest.raises(RuntimeError, match="every trial failed"):
+        run_search(runner=runner, **search_kwargs(tmp_path))
+
+
+def test_search_flags_coupled_grid_points(tmp_path):
+    big_bucket = 4.0 * 2  # computed: this test must not trip DP105 itself
+    spec = (f"train.update_sharding=sharded;train.bucket_mb={big_bucket};"
+            f"train.quant_block_size=256;train.collective_dtype=int8")
+    profile = run_search(runner=StubRunner(),
+                         **search_kwargs(
+                             tmp_path, space=SearchSpace.parse(spec)))
+    assert any("int8 codec" in w for w in profile["warnings"])
+
+
+# ---------------------------------------------------------------------------
+# the chaos gate (stubbed): the planted fragile candidate must lose
+# ---------------------------------------------------------------------------
+
+class StubGate:
+    def __init__(self, ok=lambda tamper: not tamper):
+        self.calls = []
+        self.ok = ok
+
+    def __call__(self, knobs, workdir, *, seed, tamper=False):
+        self.calls.append((knobs.get("train.quant_block_size"), tamper))
+        ok = self.ok(tamper)
+        return {"ok": ok, "config_hash": config_hash(knobs),
+                "seed": seed,
+                "failures": [] if ok else ["ORACLE: divergence"]}
+
+
+def test_gate_rejects_planted_fragile_candidate(tmp_path):
+    gate = StubGate()
+    profile = run_search(runner=StubRunner(), gate=gate,
+                         plant_fragile=True, **search_kwargs(tmp_path))
+    # The planted candidate topped the leaderboard (10x synthesized
+    # score) and was gated FIRST, against the tampered oracle.
+    assert gate.calls[0] == (PLANTED_BLOCK_SIZE, True)
+    rejected = profile["chaos_gate"]["rejected"]
+    assert len(rejected) == 1 and rejected[0]["synthesized"]
+    assert str(PLANTED_BLOCK_SIZE) in rejected[0]["label"]
+    # The crown moved down to a real, gate-passing config.
+    assert profile["config"]["train.quant_block_size"] != PLANTED_BLOCK_SIZE
+    assert profile["chaos_gate"]["verdict"]["ok"]
+    assert profile["objective"]["value"] is not None
+
+
+def test_gate_all_rejections_raise_with_receipts(tmp_path):
+    gate = StubGate(ok=lambda tamper: False)
+    with pytest.raises(RuntimeError, match="failed the chaos gate"):
+        run_search(runner=StubRunner(), gate=gate,
+                   **search_kwargs(tmp_path))
+    assert len(gate.calls) == 3  # MAX_GATE_ATTEMPTS, then surface
+
+
+def test_gate_verdicts_are_ledger_cached(tmp_path):
+    kw = search_kwargs(tmp_path)
+    gate = StubGate()
+    run_search(runner=StubRunner(), gate=gate, **kw)
+    assert len(gate.calls) == 1
+    gate2 = StubGate()
+    run_search(runner=StubRunner(), gate=gate2, **kw)
+    assert gate2.calls == []  # verdict replayed from the ledger
+
+
+# ---------------------------------------------------------------------------
+# profile contract: load/validate/precedence/mismatch
+# ---------------------------------------------------------------------------
+
+GOOD_KNOBS = {"train.update_sharding": "sharded",
+              "train.collective_dtype": "int8",
+              "train.quant_block_size": 128,
+              "train.bucket_mb": 2.0}
+
+
+def write_profile(tmp_path, knobs=None, key=None, name="tuned.json"):
+    profile = build_profile(
+        key=key or make_key("resnet18", 8, "cpu"),
+        knobs=dict(knobs or GOOD_KNOBS),
+        claims={"img_per_sec_per_chip": 123.0, "goodput": 110.0},
+        objective={"name": "throughput", "value": 123.0},
+        provenance={"seed": 0})
+    path = tmp_path / name
+    dump_profile(profile, path)
+    return path
+
+
+def test_profile_round_trip(tmp_path):
+    path = write_profile(tmp_path)
+    loaded = load_profile(path)
+    assert loaded["config"]["train.bucket_mb"] == 2.0
+    assert loaded["key"]["devices"] == 8
+
+
+def test_profile_builder_refuses_unknown_knobs():
+    with pytest.raises(ProfileError, match="not tunable"):
+        build_profile(key=make_key("resnet18", 8, "cpu"),
+                      knobs={"train.nope": 1}, claims={},
+                      objective={}, provenance={})
+
+
+def test_profile_edited_config_refused(tmp_path):
+    path = write_profile(tmp_path)
+    payload = json.loads(path.read_text())
+    payload["config"]["train.bucket_mb"] = 64.0  # hand-edit, no re-tune
+    path.write_text(json.dumps(payload))
+    with pytest.raises(ProfileError, match="config_hash"):
+        load_profile(path)
+
+
+def test_profile_schema_gate(tmp_path):
+    path = write_profile(tmp_path)
+    payload = json.loads(path.read_text())
+    payload["schema"] = "tpu_dp.tune/profile/v99"
+    path.write_text(json.dumps(payload))
+    with pytest.raises(ProfileError, match="unsupported schema"):
+        load_profile(path)
+    path.write_text('{"schema": "something/else", "key": {}}')
+    with pytest.raises(ProfileError, match="really a tuned.json"):
+        load_profile(path)
+    path.write_text("not json")
+    with pytest.raises(ProfileError, match="not valid JSON"):
+        load_profile(path)
+
+
+def test_profile_key_mismatch_is_typed_refusal(tmp_path):
+    profile = load_profile(write_profile(tmp_path))
+    check_key(profile, workload="resnet18", devices=8, backend="cpu")
+    with pytest.raises(ProfileMismatchError, match="devices 8 != 1"):
+        check_key(profile, workload="resnet18", devices=1, backend="cpu")
+    with pytest.raises(ProfileMismatchError, match="re-run"):
+        check_key(profile, workload="resnet18", devices=8, backend="tpu")
+    with pytest.raises(ProfileMismatchError, match="workload"):
+        check_key(profile, workload="resnet50", devices=8, backend="cpu")
+
+
+def test_apply_profile_explicit_flags_win(tmp_path):
+    profile = load_profile(write_profile(tmp_path))
+    cfg = Config()
+    applied = apply_profile(cfg, profile,
+                            explicit={"train.bucket_mb"})
+    assert cfg.train.bucket_mb != 2.0  # explicit path untouched
+    assert cfg.train.quant_block_size == 128
+    assert cfg.train.collective_dtype == "int8"
+    assert "train.bucket_mb" not in applied
+    assert "train.quant_block_size" in applied
+
+
+def test_parse_cli_profile_precedence(tmp_path):
+    path = write_profile(tmp_path)
+    cfg = parse_cli([f"--profile={path}", "--train.bucket_mb=9"])
+    assert cfg.train.bucket_mb == 9.0  # the typed flag wins
+    assert cfg.train.quant_block_size == 128  # the profile fills gaps
+    assert cfg.train.collective_dtype == "int8"
+    assert cfg.train.profile == str(path)
+    with pytest.raises(ValueError, match="at most one --profile"):
+        parse_cli([f"--profile={path}", f"--profile={path}"])
+    with pytest.raises(ValueError, match="needs a tuned.json"):
+        parse_cli(["--profile="])
+
+
+# ---------------------------------------------------------------------------
+# the coupling guard: one rule, three surfaces
+# ---------------------------------------------------------------------------
+
+def test_coupling_warning_trips_only_on_the_pair():
+    assert coupling_warning(4.0, 256, "int8")
+    assert coupling_warning(8, "512", "i8")  # CLI-string coercion
+    assert coupling_warning(3.9, 256, "int8") is None
+    assert coupling_warning(4.0, 255, "int8") is None
+    assert coupling_warning(4.0, 256, "bf16") is None
+    assert coupling_warning(4.0, 256, "") is None
+    assert coupling_warning("garbage", 256, "int8") is None
+
+
+DP105_TRIP = (
+    "def fast_config():\n"
+    "    return dict(bucket_mb=8.0, quant_block_size=512,\n"
+    "                collective_dtype='int8')\n"
+)
+
+
+def test_dp105_flags_hardcoded_cliff_with_scope_symbol():
+    findings = coupling.lint_source("x.py", DP105_TRIP)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "DP105" and f.symbol == "fast_config"
+    assert "int8" in f.message
+
+
+def test_dp105_dict_and_argv_forms():
+    src = (
+        'CFG = {"train.bucket_mb": 4.0, "train.quant_block_size": 256,\n'
+        '       "train.collective_dtype": "int8"}\n'
+        'ARGV = ["--train.bucket_mb=8", "--train.quant_block_size=256",\n'
+        '        "--train.collective_dtype=int8"]\n'
+    )
+    findings = coupling.lint_source("x.py", src)
+    assert sorted(f.line for f in findings) == [1, 3]
+
+
+def test_dp105_silent_below_threshold_and_on_variables():
+    ok = (
+        "a = dict(bucket_mb=1.0, quant_block_size=512,\n"
+        "         collective_dtype='int8')\n"
+        "b = dict(bucket_mb=8.0, quant_block_size=512,\n"
+        "         collective_dtype='bf16')\n"
+        "blk = 512\n"
+        "c = dict(bucket_mb=8.0, quant_block_size=blk,\n"
+        "         collective_dtype='int8')\n"  # non-constant: not a pin
+    )
+    assert coupling.lint_source("x.py", ok) == []
+
+
+def test_dp105_pragma_suppresses():
+    src = DP105_TRIP.replace(
+        "collective_dtype='int8')",
+        "collective_dtype='int8')  # dplint: allow(DP105)")
+    assert coupling.lint_source("x.py", src) == []
+
+
+def test_dp105_registered_in_rules_table():
+    from tpu_dp.analysis.report import RULES
+    title, failure = RULES["DP105"]
+    assert "coupled" in title and "coupling_warning" in failure
+
+
+# ---------------------------------------------------------------------------
+# trial config mapping + archive stamp (satellite 2)
+# ---------------------------------------------------------------------------
+
+def test_trial_cfg_forces_comm_profile_and_maps_knobs():
+    knobs = {"train.bucket_mb": 1.5, "train.quant_block_size": 64,
+             "train.collective_dtype": "int8",
+             "train.update_sharding": "sharded"}
+    cfg = trial_cfg(knobs, {"measure_steps": 2, "latency_steps": 3},
+                    model="resnet18", per_chip_batch=2, platform="cpu")
+    assert cfg["comm_profile"] is True
+    assert cfg["bucket_mb"] == 1.5 and cfg["quant_block_size"] == 64
+    assert cfg["collective_dtype"] == "int8"
+    assert cfg["measure_steps"] == 2 and cfg["steps_per_call"] == 1
+
+
+def test_archive_stamps_schema_and_config_hash(tmp_path, monkeypatch):
+    from tpu_dp.tune.trial import load_bench
+
+    bench = load_bench()
+    monkeypatch.setattr(bench, "RESULTS_PATH",
+                        tmp_path / "results.jsonl")
+    bench.archive({"value": 1.0, "backend": "cpu",
+                   "config": {"bucket_mb": 1.0}})
+    row = json.loads(
+        (tmp_path / "results.jsonl").read_text().splitlines()[0])
+    assert row["schema"] == bench.ARCHIVE_SCHEMA
+    assert row["config_hash"] == config_hash({"bucket_mb": 1.0})
+    assert row["smoke"] is True  # cpu rows stay tagged
+
+
+def test_executable_knobs_are_a_subset_of_profile_knobs():
+    from tpu_dp.tune.profile import PROFILE_KNOBS
+    assert EXECUTABLE_KNOBS <= set(PROFILE_KNOBS)

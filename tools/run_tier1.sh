@@ -121,6 +121,23 @@
 #                                    # error-feedback ablation, guard/NaN
 #                                    # interaction, residual checkpoint
 #                                    # resharding + kill/resume).
+#   tools/run_tier1.sh --tune       # self-tuning lane (docs/TUNE.md): a
+#                                    # real seeded 3-config search on the
+#                                    # 8-virtual-device CPU mesh (tiny
+#                                    # budget, fenced trials, chaos gate)
+#                                    # with --plant-fragile ON — the gate
+#                                    # must reject the fabricated
+#                                    # leaderboard top with receipts; the
+#                                    # written tuned.json is re-earned by
+#                                    # `tune validate` (exit 0), a
+#                                    # byte-identical profile must fall
+#                                    # out of a cached re-search, a
+#                                    # tampered claims block must fail
+#                                    # validation (exit 1), and bench.py
+#                                    # must refuse a mis-keyed profile
+#                                    # (exit 2). Archives artifacts/
+#                                    # tune_report.json + tuned.json,
+#                                    # then the -m tune suite.
 #   tools/run_tier1.sh --chaos      # composed-fault chaos lane
 #                                    # (docs/CHAOS.md): 5 seeded trials
 #                                    # over the default fault palette —
@@ -608,6 +625,114 @@ print("quant smoke:", json.dumps({"compression_vs_f32":
 PY
     echo "quant smoke: artifacts/quant_report.json"
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m quant \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--tune" ]; then
+    # Self-tuning lane (docs/TUNE.md): the whole tentpole end-to-end on
+    # the 8-virtual-device CPU mesh. A no-auto 3-point bucket ladder
+    # keeps the search to three fenced trials + two chaos-gate trials
+    # (the planted fabricated top against a tampered oracle — must be
+    # rejected — then the real winner). Everything downstream is
+    # exit-coded: validate re-earns the claims, a cached re-search must
+    # reproduce the profile byte-for-byte, a hand-edited claims block
+    # must flunk validation, and a mis-keyed profile must be refused by
+    # bench.py before it measures anything.
+    mkdir -p artifacts
+    TUNE=$(mktemp -d /tmp/tpu_dp_tune.XXXXXX) || exit 1
+    TUNE_SPACE='train.update_sharding=sharded;train.collective_dtype=int8;train.quant_block_size=64;train.bucket_mb=0.0,0.25,1.0'
+    env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m tpu_dp.tune --seed 20260806 --budget tiny \
+        --space "$TUNE_SPACE" --platform cpu --per-chip-batch 2 \
+        --plant-fragile --workdir "$TUNE" \
+        --out "$TUNE/tuned.json" || exit $?
+    # Bitwise reproduction: the same (seed, ledger) must re-derive the
+    # profile without running a single subprocess.
+    env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m tpu_dp.tune --seed 20260806 --budget tiny \
+        --space "$TUNE_SPACE" --platform cpu --per-chip-batch 2 \
+        --plant-fragile --workdir "$TUNE" \
+        --out "$TUNE/tuned_replay.json" || exit $?
+    cmp "$TUNE/tuned.json" "$TUNE/tuned_replay.json" || {
+        echo "tune lane: cached re-search is not byte-identical"; exit 1; }
+    env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m tpu_dp.tune validate --profile "$TUNE/tuned.json" \
+        --platform cpu --out artifacts/tune_validate.json || exit $?
+    env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python - "$TUNE" <<'PY' || exit 1
+import json, subprocess, sys
+from pathlib import Path
+from tpu_dp.tune.profile import (build_profile, dump_profile, load_profile,
+                                 make_key)
+tune = Path(sys.argv[1])
+prof = load_profile(tune / "tuned.json")  # schema/key/hash all validated
+assert prof["key"] == {"workload": "resnet18", "devices": 8,
+                       "backend": "cpu", "device_kind": "cpu"}, prof["key"]
+assert prof["provenance"]["grid_points"] == 3, prof["provenance"]
+assert len(prof["provenance"]["trial_sequence"]) == 3
+gate = prof["chaos_gate"]
+assert gate["verdict"]["ok"], gate
+rej = gate["rejected"]
+assert len(rej) == 1 and rej[0]["synthesized"], rej  # the planted top
+assert "block333" in rej[0]["label"], rej
+assert rej[0]["claimed_score"] > prof["objective"]["value"], rej
+assert prof["claims"]["img_per_sec_per_chip"] > 0, prof["claims"]
+assert prof["claims"]["exposed_comm_ms"] is not None, prof["claims"]
+val = json.loads(Path("artifacts/tune_validate.json").read_text())
+assert not val["verdict"]["regressed"] and val["verdict"]["compared"] >= 1
+# A hand-edited claims block (the knobs untouched, so config_hash still
+# verifies) must flunk re-validation: claims are earned, not asserted.
+tampered = json.loads((tune / "tuned.json").read_text())
+tampered["claims"]["img_per_sec_per_chip"] *= 10
+tampered["claims"]["goodput"] = (tampered["claims"].get("goodput") or 1) * 10
+(tune / "tampered.json").write_text(json.dumps(tampered))
+proc = subprocess.run(
+    [sys.executable, "-m", "tpu_dp.tune", "validate",
+     "--profile", str(tune / "tampered.json"), "--platform", "cpu"],
+    capture_output=True, text=True)
+assert proc.returncode == 1, (
+    f"tampered claims must exit 1, got {proc.returncode}\n"
+    + proc.stdout[-2000:] + proc.stderr[-2000:])
+assert "REGRESSED" in proc.stdout + proc.stderr, proc.stdout[-2000:]
+# A profile keyed for a backend this host does not have must be a typed
+# bench.py refusal (exit 2) BEFORE any measurement — never a silent
+# CPU-number fallback wearing a TPU profile's claims.
+dump_profile(build_profile(
+    key=make_key("resnet18", 8, "tpu", "v4"),
+    knobs=dict(prof["config"]), claims=dict(prof["claims"]),
+    objective=dict(prof["objective"]), provenance={"seed": 0}),
+    tune / "tpu_keyed.json")
+proc = subprocess.run(
+    [sys.executable, "bench.py", "--profile", str(tune / "tpu_keyed.json"),
+     "--platform", "cpu", "--measure-steps", "1", "--latency-steps", "2"],
+    capture_output=True, text=True)
+assert proc.returncode == 2, (
+    f"mis-keyed profile must exit 2, got {proc.returncode}\n"
+    + proc.stdout[-2000:] + proc.stderr[-2000:])
+assert "keyed for" in proc.stdout + proc.stderr, proc.stdout[-2000:]
+report = {
+    "ok": True,
+    "config_hash": prof["config_hash"],
+    "objective": prof["objective"],
+    "claims": prof["claims"],
+    "planted_rejection": rej[0],
+    "validate": val["verdict"],
+    "tampered_claims_exit": 1,
+    "miskeyed_bench_exit": 2,
+}
+Path("artifacts/tune_report.json").write_text(
+    json.dumps(report, indent=2) + "\n")
+Path("artifacts/tuned.json").write_bytes(
+    (tune / "tuned.json").read_bytes())
+print("tune lane:", json.dumps({
+    "crowned": prof["config_hash"],
+    "objective": prof["objective"]["value"],
+    "planted_rejected": rej[0]["label"],
+}))
+PY
+    rm -rf "$TUNE"
+    echo "tune lane: artifacts/tune_report.json + artifacts/tuned.json"
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m tune \
         -p no:cacheprovider
 fi
 

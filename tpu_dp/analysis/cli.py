@@ -34,7 +34,7 @@ import importlib.util
 import os
 import sys
 
-from tpu_dp.analysis import astlint, donation, recompile
+from tpu_dp.analysis import astlint, coupling, donation, recompile
 from tpu_dp.analysis.report import (
     Finding,
     apply_baseline,
@@ -185,6 +185,7 @@ def main(argv: list[str] | None = None) -> int:
             with open(f, encoding="utf-8") as fh:
                 sources[f] = fh.read()
             findings.extend(astlint.lint_source(f, sources[f]))
+            findings.extend(coupling.lint_source(f, sources[f]))
             findings.extend(donation.check_source(f, sources[f]))
             findings.extend(recompile.lint_source(f, sources[f]))
             hooks[f] = _module_hooks(f, sources[f])

@@ -1,0 +1,132 @@
+"""DP105: coupled bucket/quant knobs pinned at a known quality cliff.
+
+`tpu_dp.config.coupling_warning` documents the interaction: with the int8
+collective codec, buckets of >= ~4 MB fused with `quant_block_size >= 256`
+flatten per-block scale resolution enough to visibly hurt convergence — each
+knob is fine alone, the *pair* is the cliff. The runtime warns when a live
+`Config` trips the combo; this rule finds the same combo frozen into source,
+where no warning will ever fire for the reader: a call's keyword arguments, a
+dict literal, or a literal argv list that constant-binds all three knobs
+(`bucket_mb`, `quant_block_size`, `collective_dtype`, bare or
+``train.``-dotted) at tripping values.
+
+Sites that trip deliberately — tests exercising the warning itself, fixtures
+for the tuner's coupling flags — carry ``# dplint: allow(DP105)`` on the
+call/dict line. The verdict is delegated to `coupling_warning` so the lint
+rule and the runtime warning can never disagree about where the cliff is.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_dp.analysis import pragmas
+from tpu_dp.analysis.astlint import scope_at, scope_index
+from tpu_dp.analysis.report import Finding
+from tpu_dp.config import coupling_warning
+
+RULE = "DP105"
+
+# Accepted spellings of each knob at a binding site. Dict literals and argv
+# lists also use the dotted `train.` form (the Config.override path).
+_KNOB_NAMES = {
+    "bucket_mb": "bucket_mb",
+    "train.bucket_mb": "bucket_mb",
+    "quant_block_size": "quant_block_size",
+    "train.quant_block_size": "quant_block_size",
+    "collective_dtype": "collective_dtype",
+    "train.collective_dtype": "collective_dtype",
+}
+
+
+def _const(node: ast.AST) -> object:
+    """The literal value of a constant expression, else None.
+
+    Negative numbers arrive as UnaryOp(USub, Constant); anything non-literal
+    (a Name, an attribute load) returns None and the site is skipped — DP105
+    only judges values the source pins, never what a variable might hold.
+    """
+    if isinstance(node, ast.Constant):
+        return node.value
+    if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub)
+            and isinstance(node.operand, ast.Constant)
+            and isinstance(node.operand.value, (int, float))):
+        return -node.operand.value
+    return None
+
+
+def _site_bindings(node: ast.AST) -> dict[str, object] | None:
+    """knob -> constant value for one binding site, or None if not a site.
+
+    A site is a Call (keyword args), a Dict literal (string keys), or a
+    list/tuple of ``--knob=value`` argv strings.
+    """
+    found: dict[str, object] = {}
+    if isinstance(node, ast.Call):
+        for kw in node.keywords:
+            knob = _KNOB_NAMES.get(kw.arg or "")
+            if knob is None:
+                continue
+            value = _const(kw.value)
+            if value is not None:
+                found[knob] = value
+    elif isinstance(node, ast.Dict):
+        for key, value_node in zip(node.keys, node.values):
+            if key is None or not isinstance(key, ast.Constant):
+                continue
+            knob = _KNOB_NAMES.get(str(key.value))
+            if knob is None:
+                continue
+            value = _const(value_node)
+            if value is not None:
+                found[knob] = value
+    elif isinstance(node, (ast.List, ast.Tuple)):
+        for elt in node.elts:
+            if not isinstance(elt, ast.Constant) or not isinstance(
+                    elt.value, str):
+                continue
+            text = elt.value.lstrip("-")
+            name, sep, raw = text.partition("=")
+            knob = _KNOB_NAMES.get(name)
+            if knob is None or not sep:
+                continue
+            found[knob] = raw
+    else:
+        return None
+    return found
+
+
+def lint_source(path: str, source: str) -> list[Finding]:
+    """DP105 findings for one file's source."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []
+    allowed = pragmas.collect(source)
+    scopes = scope_index(tree)
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        bound = _site_bindings(node)
+        if not bound or len(bound) < 3:
+            continue
+        warning = coupling_warning(
+            bound["bucket_mb"], bound["quant_block_size"],
+            bound["collective_dtype"],
+        )
+        if warning is None:
+            continue
+        line = node.lineno
+        span = tuple(range(line, (node.end_lineno or line) + 1))
+        if pragmas.is_allowed(allowed, RULE, span):
+            continue
+        findings.append(Finding(
+            rule=RULE,
+            path=path,
+            line=line,
+            message=(
+                f"source pins the coupled int8 cliff ({warning}); tune the "
+                f"pair via `python -m tpu_dp.tune` or pragma if deliberate"
+            ),
+            symbol=scope_at(scopes, line),
+        ))
+    return findings

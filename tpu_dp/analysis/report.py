@@ -120,6 +120,13 @@ RULES: dict[str, tuple[str, str]] = {
         "jax.device_get / .block_until_ready in device code serializes "
         "dispatch against execution every step",
     ),
+    "DP105": (
+        "coupled bucket/quant knobs pinned at a known quality cliff",
+        "source hardcoding bucket_mb >= 4 with quant_block_size >= 256 "
+        "under the int8 codec shares coarse absmax scales across a large "
+        "fused payload — a convergence cliff no throughput-ranked fenced "
+        "trial can see (same threshold as tpu_dp.config.coupling_warning)",
+    ),
     "DP201": (
         "gradient never reduced over the data axis",
         "a parameter whose gradient is not all-reduced trains on one "

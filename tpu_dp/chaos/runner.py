@@ -173,7 +173,7 @@ def _repo_root() -> Path:
 
 
 def _trial_argv(ckpt_dir: Path, spec: str, guard_action: str,
-                resume: bool) -> list[str]:
+                resume: bool, extra_argv: Sequence[str] = ()) -> list[str]:
     args = [
         sys.executable, str(_repo_root() / "train.py"),
         "--data.dataset=synthetic",
@@ -189,6 +189,10 @@ def _trial_argv(ckpt_dir: Path, spec: str, guard_action: str,
         args += ["--guard.enabled=true",
                  f"--guard.action={guard_action}",
                  "--guard.spike_min_steps=4", "--guard.spike_z=12"]
+    # Caller-supplied config overrides (the tune chaos gate compiles the
+    # candidate's knobs into the trial) — before the fault/resume args so
+    # they can never shadow the schedule under test.
+    args += list(extra_argv)
     if spec:
         args.append(f"--resilience.fault={spec}")
     if resume:
@@ -242,8 +246,10 @@ def _relaunch_remainder(clauses: Sequence[FaultPlan]) -> list[FaultPlan]:
 
 def run_trial(schedule: TrialSchedule, workdir: Path,
               timeout_s: float = 180.0,
-              max_relaunches: int = 3) -> TrialResult:
-    """One trial under the supervisor loop (see module docstring)."""
+              max_relaunches: int = 3,
+              extra_argv: Sequence[str] = ()) -> TrialResult:
+    """One trial under the supervisor loop (see module docstring).
+    ``extra_argv`` rides every incarnation (launch and relaunch alike)."""
     workdir.mkdir(parents=True, exist_ok=True)
     ckpt = workdir / "ck"
     clauses = list(schedule.clauses)
@@ -253,7 +259,8 @@ def run_trial(schedule: TrialSchedule, workdir: Path,
     deadline = t0 + timeout_s
     while True:
         spec = ";".join(c.to_spec() for c in clauses)
-        argv = _trial_argv(ckpt, spec, schedule.guard_action, resume)
+        argv = _trial_argv(ckpt, spec, schedule.guard_action, resume,
+                           extra_argv)
         budget = deadline - time.time()
         if budget <= 0:
             return TrialResult(schedule, incarnations, ckpt,

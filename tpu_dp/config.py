@@ -145,6 +145,11 @@ class TrainConfig:
     # Step-ranged profiling: "START:END" global steps traced to
     # train.profile_dir (which must be set) instead of the whole run.
     profile_steps: str = ""
+    # Path of the tuned.json this run loaded via --profile ("" = none).
+    # Informational: parse_cli records it after applying the profile so
+    # checkpoint meta / flight-recorder dumps name the profile a run's
+    # knobs came from. The knobs themselves land in their own fields.
+    profile: str = ""
 
 
 @dataclass
@@ -534,6 +539,49 @@ def _coerce(value: str, current: Any):
     return value
 
 
+#: The coupled-knob regime one shared rule warns about (used verbatim by
+#: the Trainer's config validation, the tune search space, and dplint
+#: DP105 — three surfaces, ONE threshold definition).
+COUPLING_BUCKET_MB = 4.0
+COUPLING_QUANT_BLOCK = 256
+
+
+def coupling_warning(bucket_mb, quant_block_size,
+                     collective_dtype) -> str | None:
+    """The bucket/quant coupling guard (docs/TUNE.md "Coupled knobs").
+
+    ``train.bucket_mb`` and ``train.quant_block_size`` interact under the
+    int8 codec: each bucket quantizes independently (per-bucket absmax
+    scales and error-feedback residuals), so a large bucket quantized
+    with large scaling blocks couples many MB of gradient payload to a
+    few coarse scales — one outlier leaf in the bucket widens the scale
+    for everything sharing its block, and the residual feedback that
+    would absorb the rounding now spans the whole bucket. Measured as a
+    quality cliff, not a perf cliff, which is exactly why a
+    throughput-ranked tuner needs the warning: the fenced trial cannot
+    see it. Returns the warning string, or None when the combination is
+    fine.
+    """
+    try:
+        bucket = float(bucket_mb or 0.0)
+        block = int(quant_block_size or 0)
+    except (TypeError, ValueError):
+        return None
+    if (str(collective_dtype) in ("int8", "i8")
+            and bucket >= COUPLING_BUCKET_MB
+            and block >= COUPLING_QUANT_BLOCK):
+        return (
+            f"train.bucket_mb={bucket:g} with "
+            f"train.quant_block_size={block} under the int8 codec: "
+            f"buckets >= {COUPLING_BUCKET_MB:g} MB quantized with blocks "
+            f">= {COUPLING_QUANT_BLOCK} share coarse absmax scales across "
+            f"a large payload (outlier-widened scales + bucket-wide "
+            f"error feedback); shrink quant_block_size or bucket_mb "
+            f"(docs/TUNE.md \"Coupled knobs\")"
+        )
+    return None
+
+
 # BASELINE.json's five target configs as presets (SURVEY.md §6).
 def _preset_reference_single() -> Config:
     """Config 1 analogue + exact reference parity: `Net`, batch 4, 2 epochs."""
@@ -608,6 +656,7 @@ def parse_cli(argv: Sequence[str]) -> Config:
     """
     cfg: Config | None = None
     from_meta = False
+    profile_path = ""
     overrides: list[tuple[str, str]] = []
     for arg in argv:
         if not arg.startswith("--"):
@@ -615,6 +664,17 @@ def parse_cli(argv: Sequence[str]) -> Config:
         key, _, value = arg[2:].partition("=")
         if key in ("preset", "config") and cfg is not None:
             raise ValueError("give at most one of --preset / --config")
+        if key == "profile":
+            # --profile=tuned.json: a tpu_dp.tune profile overlay. Applied
+            # BEFORE the override loop below, so any explicit
+            # --section.field flag the user typed wins over the profile
+            # (tuned defaults fill gaps; they never clobber intent).
+            if not value:
+                raise ValueError("--profile needs a tuned.json path")
+            if profile_path:
+                raise ValueError("give at most one --profile")
+            profile_path = value
+            continue
         if key == "preset":
             if value not in PRESETS:
                 raise ValueError(
@@ -655,6 +715,17 @@ def parse_cli(argv: Sequence[str]) -> Config:
             "--train.resume=true (continue in place) explicitly"
         )
     cfg = cfg or Config()
+    if profile_path:
+        # Lazy import: tune.profile is stdlib-only, but config stays
+        # importable even if the tune package is stripped from a deploy.
+        from tpu_dp.tune.profile import apply_profile, load_profile
+
+        profile = load_profile(profile_path)
+        apply_profile(cfg, profile)
+        cfg.train.profile = profile_path
+        # Key enforcement (workload/mesh/backend) happens in the Trainer,
+        # which can see the live mesh; parse_cli only guarantees the file
+        # is a valid, untampered profile.
     for key, value in overrides:
         cfg.override(key, value)
     return cfg

@@ -655,6 +655,13 @@ def load_baseline(path: Path) -> dict:
     so `serve_elastic_report.json` of a known-good run gates the next
     one directly."""
     rec = json.loads(path.read_text())
+    if str(rec.get("schema", "")).startswith("tpu_dp.tune/profile/"):
+        # A tpu_dp.tune tuned.json: its `claims` block IS the baseline —
+        # the fenced numbers the winning config earned when it was
+        # crowned, in these exact signal units. `obsctl diff
+        # --baseline tuned.json` therefore re-validates a tuned run
+        # against what the profile claims it should deliver.
+        rec = dict(rec.get("claims") or {})
     latency = rec.get("latency") or {}
     quant = rec.get("quant") or {}
     q_steps = max(int(quant.get("stats_steps", 0) or 0), 1)
@@ -674,6 +681,11 @@ def load_baseline(path: Path) -> dict:
     return {
         "mfu": rec.get("mfu"),
         "goodput": rec.get("goodput"),
+        # The BENCH throughput headline (archived rows carry it as
+        # `value`; tune claims under its signal name) — the signal
+        # `tune validate` certifies a profile against.
+        "img_per_sec_per_chip": rec.get(
+            "img_per_sec_per_chip", rec.get("value")),
         "p95_ms": rec.get("p95_ms", latency.get("p95_ms")),
         "quant_overflow_per_step": rec.get(
             "quant_overflow_per_step", rate(quant.get("overflow"))),
@@ -703,6 +715,7 @@ def diff_verdict(run: dict, base: dict, tolerance: float) -> dict:
     silently passed.
     """
     signals = [("mfu", True), ("goodput", True),
+               ("img_per_sec_per_chip", True),
                ("p95_ms", False),
                ("quant_overflow_per_step", False),
                ("quant_clip_blocks_per_step", False),

@@ -109,7 +109,34 @@ def main(argv=None) -> int:
     ap.add_argument("--sigterm-drains", type=int, default=None,
                     help="SIGTERM drains this replica sid instead of the "
                          "whole tier")
+    ap.add_argument("--profile", default=None,
+                    help="apply a tpu_dp.tune tuned.json: fills the "
+                         "serving ladder knobs (--buckets, --max-wait-ms) "
+                         "and the model (from the profile key's workload) "
+                         "that were NOT given explicitly — explicit flags "
+                         "win; a (workload, devices, backend) key mismatch "
+                         "is a refusal (exit 2), never a silent fallback")
     args = ap.parse_args(argv)
+
+    profile = None
+    if args.profile is not None:
+        from tpu_dp.tune.profile import ProfileError, load_profile
+
+        try:
+            profile = load_profile(args.profile)
+        except ProfileError as e:
+            print(f"serve: {e}", file=sys.stderr)
+            return 2
+        explicit = {a.split("=", 1)[0]
+                    for a in (sys.argv[1:] if argv is None else argv)
+                    if a.startswith("--")}
+        knobs = profile["config"]
+        if "--buckets" not in explicit and knobs.get("serve.buckets"):
+            args.buckets = str(knobs["serve.buckets"])
+        if "--max-wait-ms" not in explicit and "serve.max_wait_ms" in knobs:
+            args.max_wait_ms = float(knobs["serve.max_wait_ms"])
+        if "--model" not in explicit:
+            args.model = str(profile["key"]["workload"])
 
     # Backend pinning BEFORE jax imports: the smoke must exercise the
     # multi-replica fan-out, so on CPU expose 8 virtual devices (the
@@ -125,6 +152,20 @@ def main(argv=None) -> int:
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         jax.config.update("jax_platforms", "cpu")
+
+    if profile is not None:
+        # The ladder was tuned for a (workload, mesh, backend); serving a
+        # different one under its numbers is the lie --profile refuses.
+        from tpu_dp.tune.profile import ProfileMismatchError, check_key
+
+        try:
+            check_key(profile, workload=args.model,
+                      devices=len(jax.devices()),
+                      backend=jax.default_backend(),
+                      where="this serve run")
+        except ProfileMismatchError as e:
+            print(f"serve: --profile {args.profile}: {e}", file=sys.stderr)
+            return 2
 
     import numpy as np
 

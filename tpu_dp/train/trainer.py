@@ -222,6 +222,33 @@ class Trainer:
                 "reduce-scatter; set train.update_sharding=sharded"
             )
         self._quant_pub_step = -1  # last window whose codec stats published
+        # Coupled-knob guard (docs/TUNE.md "Coupled knobs"): the SAME rule
+        # the tune search space and dplint DP105 apply — a hand-set config
+        # gets the identical warning a tuner-proposed one would.
+        from tpu_dp.config import coupling_warning
+
+        coupled = coupling_warning(cfg.train.bucket_mb,
+                                   cfg.train.quant_block_size,
+                                   cfg.train.collective_dtype)
+        if coupled:
+            log0("config warning: %s", coupled)
+        # A tuned profile (train.profile, set by --profile) is only valid
+        # for the (workload, mesh geometry, backend) it was searched on —
+        # re-check against the LIVE topology: parse_cli validated the file
+        # but could not see the mesh. Typed refusal, never silent drift.
+        if cfg.train.profile:
+            import jax
+
+            from tpu_dp.tune.profile import check_key, load_profile
+
+            check_key(load_profile(cfg.train.profile),
+                      workload=cfg.model.name,
+                      devices=self.num_devices,
+                      backend=jax.default_backend(),
+                      where="this Trainer")
+            log0("profile: %s (key ok: %s x%d on %s)",
+                 cfg.train.profile, cfg.model.name, self.num_devices,
+                 jax.default_backend())
 
         model_kwargs = dict(
             num_classes=num_classes, dtype=dtype,

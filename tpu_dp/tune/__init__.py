@@ -1,0 +1,32 @@
+"""`tpu_dp.tune` — the self-tuning harness (docs/TUNE.md).
+
+Fenced-trial search over the coupled perf knobs (`train.bucket_mb`,
+`train.quant_block_size`, `train.collective_dtype`, the serve ladder),
+scored from real BENCH/commprof output, chaos-gated, and emitted as a
+reproducible `tuned.json` that `train.py` / `bench.py` / the serve CLI
+consume via ``--profile``.
+
+The package splits along its trust boundaries: `profile` is the durable
+artifact contract (stdlib-only), `space` the search grammar, `prior` the
+analytic bucket sizing, `trial` the bench-backed runner, `gate` the
+chaos robustness gate, `search` the deterministic driver, `__main__`
+the CLI.
+"""
+
+from tpu_dp.tune.profile import (  # noqa: F401
+    PROFILE_KNOBS,
+    PROFILE_SCHEMA,
+    ProfileError,
+    ProfileMismatchError,
+    apply_profile,
+    check_key,
+    config_hash,
+    load_profile,
+    make_key,
+)
+from tpu_dp.tune.space import (  # noqa: F401
+    BUDGETS,
+    DEFAULT_SPACE,
+    SearchSpace,
+    SpaceError,
+)

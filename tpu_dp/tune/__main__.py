@@ -1,0 +1,212 @@
+"""``python -m tpu_dp.tune`` — the self-tuning harness CLI.
+
+Two modes (docs/TUNE.md):
+
+``search`` (the default)
+    Run the seeded fenced-trial search over the declared space and write
+    the winning config as ``tuned.json``::
+
+        python -m tpu_dp.tune --seed 0 --budget small \\
+            --workdir tune_out --out tune_out/tuned.json
+
+``validate``
+    Re-earn a profile's claims: re-run the winner's fenced trial with
+    the profile's knobs and compare against the claims block through
+    `obsctl`'s diff verdict machinery. Exit 0 = claims reproduce within
+    tolerance; 1 = regression (the profile claims numbers this machine
+    does not deliver — stale, tampered, or mis-keyed); 2 = cannot
+    certify (nothing comparable measured). ::
+
+        python -m tpu_dp.tune validate --profile tune_out/tuned.json
+
+Exit codes follow the repo's CLI convention: 2 for usage errors, 1 for
+a failed search/validation, 0 for success.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tpu_dp.obs.objective import OBJECTIVES, trial_signals
+from tpu_dp.tune import gate as gate_mod
+from tpu_dp.tune import search as search_mod
+from tpu_dp.tune import trial as trial_mod
+from tpu_dp.tune.profile import (
+    ProfileError,
+    dump_profile,
+    load_profile,
+)
+from tpu_dp.tune.space import BUDGETS, DEFAULT_SPACE, SearchSpace, SpaceError
+
+#: `validate`'s default comparison set: the throughput headline and
+#: goodput — robust on every backend. Comm/p95 claims ride in the
+#: profile informationally but are too noisy on CPU smoke topologies to
+#: gate a certification on (docs/TUNE.md "Validating a profile").
+VALIDATE_SIGNALS = "img_per_sec_per_chip,goodput"
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dp.tune", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="mode")
+
+    s = sub.add_parser("search", help="run the search (the default mode)")
+    v = sub.add_parser("validate", help="re-earn a profile's claims")
+    for p in (ap, s):
+        p.add_argument("--seed", type=int, default=0,
+                       help="search seed: trial order, gate schedule")
+        p.add_argument("--budget", default="small",
+                       choices=sorted(BUDGETS),
+                       help="successive-halving rung ladder")
+        p.add_argument("--space", default=DEFAULT_SPACE,
+                       help="search-space spec (docs/TUNE.md grammar)")
+        p.add_argument("--workdir", default="tune_out",
+                       help="ledger + gate workdirs live here")
+        p.add_argument("--out", default=None,
+                       help="tuned.json path (default <workdir>/tuned.json)")
+        p.add_argument("--objective", default="throughput",
+                       choices=OBJECTIVES)
+        p.add_argument("--model", default="resnet18")
+        p.add_argument("--per-chip-batch", type=int, default=2)
+        p.add_argument("--platform", default=None, choices=["cpu"],
+                       help="force the cpu backend (harness smoke test)")
+        p.add_argument("--point-timeout", type=float, default=420.0,
+                       help="per-trial subprocess timeout (s)")
+        p.add_argument("--gate-timeout", type=float, default=300.0,
+                       help="per-chaos-gate-run timeout (s)")
+        p.add_argument("--no-gate", action="store_true",
+                       help="skip the chaos gate (NOT for real profiles)")
+        p.add_argument("--no-archive", action="store_true",
+                       help="don't append trials to benchmarks/results.jsonl")
+        p.add_argument("--plant-fragile", action="store_true",
+                       help="self-test: inject a fragile candidate with a "
+                            "synthesized top score; the gate must reject it")
+    v.add_argument("--profile", required=True,
+                   help="the tuned.json to validate")
+    v.add_argument("--tolerance", type=float, default=0.5,
+                   help="relative claim tolerance (CPU smoke runs are "
+                        "noisy; tighten on real accelerators)")
+    v.add_argument("--signals", default=VALIDATE_SIGNALS,
+                   help="comma list of claim signals to certify against")
+    v.add_argument("--point-timeout", type=float, default=420.0)
+    v.add_argument("--platform", default=None, choices=["cpu"])
+    v.add_argument("--out", default=None,
+                   help="write the validation report JSON here")
+    return ap
+
+
+def cmd_search(args) -> int:
+    try:
+        space = SearchSpace.parse(args.space)
+    except SpaceError as e:
+        print(f"tune: bad --space: {e}", file=sys.stderr)
+        return 2
+    workdir = Path(args.workdir)
+    out = Path(args.out) if args.out else workdir / "tuned.json"
+    runner = trial_mod.TrialRunner(
+        model=args.model, per_chip_batch=args.per_chip_batch,
+        platform=args.platform, point_timeout_s=args.point_timeout,
+        archive=not args.no_archive)
+    gate = None
+    if not args.no_gate:
+        def gate(knobs, gdir, *, seed, tamper=False):
+            return gate_mod.chaos_gate(knobs, gdir, seed=seed,
+                                       tamper=tamper,
+                                       timeout_s=args.gate_timeout)
+    try:
+        profile = search_mod.run_search(
+            seed=args.seed, budget=args.budget, space=space,
+            runner=runner, workdir=workdir, objective=args.objective,
+            workload=args.model, gate=gate,
+            plant_fragile=args.plant_fragile,
+            extra_provenance={"trial": {
+                "model": args.model,
+                "per_chip_batch": args.per_chip_batch,
+                "platform": args.platform,
+            }})
+    except RuntimeError as e:
+        print(f"tune: {e}", file=sys.stderr)
+        return 1
+    dump_profile(profile, out)
+    print(f"tune: wrote {out} "
+          f"(config_hash {profile['config_hash']}, "
+          f"{profile['objective']['name']}="
+          f"{profile['objective']['value']})")
+    for w in profile.get("warnings", ()):
+        print(f"tune: warning: {w}")
+    return 0
+
+
+def cmd_validate(args) -> int:
+    try:
+        profile = load_profile(args.profile)
+    except ProfileError as e:
+        print(f"tune validate: {e}", file=sys.stderr)
+        return 1
+    from tpu_dp.obs.obsctl import diff_verdict
+
+    prov_trial = (profile.get("provenance") or {}).get("trial") or {}
+    rungs = (profile.get("provenance") or {}).get("rungs") or []
+    rung = dict(rungs[-1]) if rungs else {"measure_steps": 2,
+                                         "latency_steps": 3}
+    platform = args.platform or prov_trial.get("platform") or (
+        "cpu" if profile["key"].get("backend") == "cpu" else None)
+    runner = trial_mod.TrialRunner(
+        model=prov_trial.get("model", profile["key"]["workload"]),
+        per_chip_batch=int(prov_trial.get("per_chip_batch", 2)),
+        platform=platform, point_timeout_s=args.point_timeout,
+        archive=False)
+    print(f"tune validate: re-running the winner "
+          f"(config_hash {profile['config_hash']}) at rung {rung}")
+    record = runner(profile["config"], rung)
+    if record.get("value") is None:
+        print(f"tune validate: re-run trial failed: "
+              f"{record.get('error')}", file=sys.stderr)
+        return 2
+    keys = [s.strip() for s in args.signals.split(",") if s.strip()]
+    run_sig = {k: v for k, v in trial_signals(record).items() if k in keys}
+    base_sig = {k: v for k, v in profile["claims"].items() if k in keys}
+    verdict = diff_verdict(run_sig, base_sig, args.tolerance)
+    report = {
+        "profile": str(args.profile),
+        "config_hash": profile["config_hash"],
+        "signals": keys,
+        "verdict": verdict,
+        "measured": run_sig,
+        "claimed": base_sig,
+    }
+    if args.out:
+        Path(args.out).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    for c in verdict["checks"]:
+        if c["verdict"] != "skipped":
+            print(f"tune validate: {c['signal']}: run={c['run']} "
+                  f"claimed={c['baseline']} -> {c['verdict']}")
+    if verdict["compared"] == 0:
+        print("tune validate: CANNOT CERTIFY — no comparable signals "
+              "(claims and re-run share nothing)", file=sys.stderr)
+        return 2
+    if verdict["regressed"]:
+        print("tune validate: REGRESSED — this machine does not deliver "
+              "the profile's claimed numbers (stale, tampered, or "
+              "mis-keyed profile)", file=sys.stderr)
+        return 1
+    print(f"tune validate: certified — claims reproduce within "
+          f"{args.tolerance:.0%}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = _build_parser()
+    args = ap.parse_args(argv)
+    if args.mode == "validate":
+        return cmd_validate(args)
+    return cmd_search(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

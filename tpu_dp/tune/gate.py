@@ -1,0 +1,113 @@
+"""The robustness gate: a tuned config must survive chaos to be crowned.
+
+A search that ranks by throughput alone will happily crown a config that
+is fast until the first preemption — "fast but fragile" is exactly the
+failure mode a self-tuning harness must not automate. So before a
+candidate becomes `tuned.json`, it re-runs the chaos harness's composed
+fault trial (kill/preempt/storage faults over the real ``train.py``,
+`tpu_dp.chaos.runner`) **with the candidate's knobs compiled in**, and
+the never-faulted oracle for the bitwise-params comparison is run with
+the SAME knobs — the gate asks "does THIS config recover exactly-once",
+not "does the default config".
+
+The schedule is pinned: ``Random(f"{seed}:gate:{config_hash}")`` — the
+gate verdict in a profile replays from (seed, knobs) alone, like every
+other number the profile carries. Sampling is restricted to the
+oracle-exact, single-world palette subset so every gate trial actually
+evaluates the strongest invariant (a ``nan`` schedule never compares the
+oracle — a gate that can pass without checking anything is a rubber
+stamp).
+
+``tamper=True`` is the planted-fragile self-test (the chaos harness's
+``--tamper-oracle`` idiom): the oracle export is bit-flipped after the
+run, so the audit MUST report an ORACLE failure — proving the gate has
+teeth before trusting it to wave real configs through.
+"""
+
+from __future__ import annotations
+
+import random
+from pathlib import Path
+from typing import Any, Mapping
+
+from tpu_dp.tune.profile import config_hash
+
+#: Executable knobs the gate compiles into the chaos trial's train.py.
+#: serve/obs/accum knobs don't change the recovery contract under test.
+GATE_KNOBS = (
+    "train.update_sharding",
+    "train.collective_dtype",
+    "train.quant_block_size",
+    "train.bucket_mb",
+)
+
+
+def knob_argv(knobs: Mapping[str, Any]) -> list[str]:
+    """The candidate's knob set as train.py CLI overrides."""
+    return [f"--{k}={knobs[k]}" for k in GATE_KNOBS if k in knobs]
+
+
+def chaos_gate(knobs: Mapping[str, Any], workdir: Path, *, seed: int,
+               tamper: bool = False, timeout_s: float = 240.0,
+               log=print) -> dict:
+    """One pinned-seed chaos trial of one candidate config.
+
+    Returns the gate verdict dict that lands in `tuned.json` (and the
+    trial ledger): ``ok``, the sampled fault spec, the audit failures,
+    and enough identity (seed, config_hash, tampered_oracle) to replay.
+    """
+    from tpu_dp.chaos import runner as chaos
+
+    chash = config_hash(knobs)
+    workdir = Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    extra = knob_argv(knobs)
+    rng = random.Random(f"{seed}:gate:{chash}")  # str: stable, not hash()
+    palette = [e for e in chaos.DEFAULT_PALETTE
+               if e.oracle_exact and e.min_world <= 1]
+    schedule = chaos.sample_schedule(rng, palette)
+    log(f"tune gate [{chash}]: spec {schedule.spec!r}"
+        + (" (tampered oracle — self-test)" if tamper else ""))
+
+    # The candidate's own oracle: same knobs, no faults. _oracle_for's
+    # cache keys on guard_action only, so the gate runs its oracle
+    # directly — two candidates' oracles must never be conflated.
+    odir = workdir / "oracle"
+    oracle_res = chaos.run_trial(
+        chaos.TrialSchedule(clauses=[], guard_action=schedule.guard_action),
+        odir, timeout_s=timeout_s, extra_argv=extra)
+    oracle = odir / "ck" / "final_params.msgpack"
+    if oracle_res.final_exit != 0 or not oracle.exists():
+        return {
+            "ok": False, "config_hash": chash, "seed": seed,
+            "spec": schedule.spec, "tampered_oracle": bool(tamper),
+            "failures": [
+                f"ORACLE RUN: never-faulted run of this config exited "
+                f"{oracle_res.final_exit} — a config that cannot even "
+                f"finish clean training cannot be tuned in"],
+        }
+    if tamper:
+        tampered = workdir / "tampered_oracle.msgpack"
+        blob = bytearray(oracle.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        tampered.write_bytes(bytes(blob))
+        oracle = tampered
+
+    result = chaos.run_trial(schedule, workdir / "trial",
+                             timeout_s=timeout_s, extra_argv=extra)
+    failures = chaos.audit_trial(result, oracle)
+    verdict = {
+        "ok": not failures,
+        "config_hash": chash,
+        "seed": seed,
+        "spec": schedule.spec,
+        "guard_action": schedule.guard_action,
+        "tampered_oracle": bool(tamper),
+        "incarnations": [
+            {k: v for k, v in inc.items() if k in ("exit", "wall_s")}
+            for inc in result.incarnations],
+        "failures": failures,
+    }
+    log(f"tune gate [{chash}]: " + ("ok" if verdict["ok"] else
+        "REJECTED — " + "; ".join(failures)[:200]))
+    return verdict

@@ -1,0 +1,109 @@
+"""Analytic bucket-size prior: candidates from a measured comm window.
+
+A blind `bucket_mb` sweep spends most of its trials in regimes the comm
+profile already rules out — buckets so large the schedule degenerates to
+the monolithic reduction, or so small the per-collective overhead
+swamps the hiding (docs/PERF.md "Overlapped collectives" measured both
+cliffs). PR 15 gave the repo the number that makes sweeping unnecessary:
+commprof's byte-exact ``exposed_comm_ms`` on the monolithic schedule is
+exactly the headroom bucketing can reclaim.
+
+The model (docs/TUNE.md "The bucket prior"): a K-bucket schedule leaves
+roughly ``comm_ms / K`` exposed — the tail bucket closes only after
+backward finishes, so its wire time has nothing left to hide under,
+while the K-1 earlier buckets overlap remaining backward compute. To
+push the exposed tail under ``TARGET_EXPOSED_FRAC`` of the measured
+exposed window we need
+
+    K* = ceil(comm_ms / (TARGET_EXPOSED_FRAC * exposed_comm_ms))
+
+and the candidate bucket sizes are the gradient payload split K* ways,
+bracketed one octave each way (K*/2, K*, 2K*) because the per-collective
+fixed cost delta is backend-specific and unmeasured. ``0`` (bucketing
+off) always rides along as the control: the prior proposes, the fenced
+trial disposes.
+
+Stdlib-only: the probe record comes in as a dict (a BENCH record from
+the trial runner, or a synthetic one in tests).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+#: The prior aims the bucketed schedule's exposed tail at this fraction
+#: of the monolithic schedule's measured exposed window.
+TARGET_EXPOSED_FRAC = 0.25
+
+#: K is clamped here: 1 bucket is the monolithic schedule (the control
+#: already covers it), and past 32 the per-collective overhead measured
+#: in docs/PERF.md dominates any tail shrink on every backend we have.
+MIN_BUCKETS = 2
+MAX_BUCKETS = 32
+
+#: Exposed windows under this are noise on every measured backend — the
+#: monolithic schedule already hides its wire time, so the prior
+#: proposes only the control.
+MIN_EXPOSED_MS = 0.05
+
+
+def grad_payload_mb(record: Mapping[str, Any]) -> float | None:
+    """The f32 gradient wire payload (MB/step) out of a probe record.
+
+    Preference order: the quant block's byte-exact f32 wire accounting
+    (`wire_bytes_per_step.f32` — present whenever the probe ran with a
+    wire codec configured), then a `grad_payload_mb` key (synthetic /
+    test records). None when the record carries neither."""
+    quant = record.get("quant") or {}
+    wire = quant.get("wire_bytes_per_step") or {}
+    if wire.get("f32"):
+        return float(wire["f32"]) / 2**20
+    if record.get("grad_payload_mb"):
+        return float(record["grad_payload_mb"])
+    return None
+
+
+def bucket_candidates(record: Mapping[str, Any],
+                      max_candidates: int = 4) -> list[float]:
+    """`train.bucket_mb` candidates from a monolithic-schedule probe.
+
+    ``record`` is a fenced BENCH record measured at ``bucket_mb=0`` with
+    comm profiling on. Returns a sorted candidate list that ALWAYS
+    includes 0.0 (the control); degenerates to ``[0.0]`` when the probe
+    shows nothing to reclaim (exposed window at noise level) or lacks
+    the numbers to size from (no comm block / no payload accounting) —
+    an honest "don't sweep" is the whole point of the prior.
+    """
+    comm = record.get("comm") or {}
+    comm_ms = comm.get("comm_ms")
+    exposed_ms = comm.get("exposed_comm_ms")
+    payload_mb = grad_payload_mb(record)
+    if not comm_ms or exposed_ms is None or not payload_mb:
+        return [0.0]
+    if exposed_ms < MIN_EXPOSED_MS:
+        return [0.0]
+    k_star = max(1, -(-float(comm_ms)
+                      // (TARGET_EXPOSED_FRAC * float(exposed_ms))))
+    candidates = [0.0]
+    for k in (k_star / 2, k_star, k_star * 2):
+        k = int(min(max(round(k), MIN_BUCKETS), MAX_BUCKETS))
+        mb = round(payload_mb / k, 4)
+        if mb > 0 and mb not in candidates:
+            candidates.append(mb)
+        if len(candidates) >= max_candidates:
+            break
+    return sorted(candidates)
+
+
+def describe(record: Mapping[str, Any], candidates: Sequence[float]) -> dict:
+    """The provenance block `tuned.json` carries for an auto-sized axis —
+    the measured window the candidates were derived from."""
+    comm = record.get("comm") or {}
+    return {
+        "comm_ms": comm.get("comm_ms"),
+        "exposed_comm_ms": comm.get("exposed_comm_ms"),
+        "overlap_frac": comm.get("overlap_frac"),
+        "grad_payload_mb": grad_payload_mb(record),
+        "target_exposed_frac": TARGET_EXPOSED_FRAC,
+        "candidates": list(candidates),
+    }

@@ -1,0 +1,217 @@
+"""The ``tuned.json`` profile: the tuner's one durable artifact.
+
+A profile is a *resolved knob set with receipts*: the winning config of a
+`tpu_dp.tune` search, the fenced numbers it claimed when it won, and
+enough provenance (seed, space, ledger digest, chaos-gate verdict) to
+re-derive it bit-for-bit from the trial ledger. Consumers — `Trainer`,
+`bench.py`, the serve engine — load it with ``--profile tuned.json``
+under two hard rules (docs/TUNE.md "Profile precedence"):
+
+1. **Explicit flags win.** A profile fills in knobs the user did not set;
+   it never overrides a `--section.field=value` the user typed. A tuned
+   default that silently clobbered an explicit flag would make every
+   debugging session a lie.
+2. **The key must match.** A profile is keyed by (workload family, mesh
+   geometry, backend): numbers tuned for 8-device CPU say nothing about
+   a v4-8, and a ResNet-18 ladder says nothing about ResNet-50. A
+   mismatch is a typed refusal (`ProfileMismatchError`), never a silent
+   fallback — the first live-TPU run after a CPU drought must not score
+   itself against a CPU-tuned profile (bench.py enforces the same rule
+   before measuring).
+
+This module is stdlib-only (no jax): config loading, the analyzer, and
+the tests all import it at zero cost.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import Any, Mapping
+
+#: Schema tag. Bump the trailing version on any breaking layout change;
+#: loaders refuse unknown majors instead of guessing.
+PROFILE_SCHEMA = "tpu_dp.tune/profile/v1"
+
+#: Knobs a profile may carry, and the only ones `apply_profile` will set.
+#: Everything is a dotted `section.field` path into `tpu_dp.config.Config`;
+#: an unknown key in a profile is a load error (a typo'd knob that loaded
+#: as a no-op would un-tune the run silently).
+PROFILE_KNOBS = (
+    "train.update_sharding",
+    "train.collective_dtype",
+    "train.quant_block_size",
+    "train.bucket_mb",
+    "train.obs",
+    "optim.grad_accum_steps",
+    "serve.buckets",
+    "serve.max_wait_ms",
+)
+
+
+class ProfileError(ValueError):
+    """A profile that cannot be loaded: bad JSON, wrong schema, bad knobs."""
+
+
+class ProfileMismatchError(ProfileError):
+    """A valid profile whose key does not describe this run — the typed
+    refusal every consumer raises instead of silently proceeding."""
+
+
+def config_hash(knobs: Mapping[str, Any]) -> str:
+    """Stable 12-hex digest of a resolved knob set.
+
+    The join key between a tune trial, its archived BENCH row
+    (`benchmarks/results.jsonl` ``config_hash``), and the profile that
+    crowned it: canonical JSON (sorted keys, no whitespace) over the
+    knob mapping, sha256, first 12 hex chars. Floats are normalized
+    through `repr` via json — 4 and 4.0 hash differently, so callers
+    must hash the RESOLVED (post-coercion) values, not raw CLI strings.
+    """
+    blob = json.dumps(dict(knobs), sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:12]
+
+
+def make_key(workload: str, devices: int, backend: str,
+             device_kind: str | None = None) -> dict:
+    """The (workload family, mesh geometry, backend) identity a profile
+    is valid for. `device_kind` rides along informationally (a v4 vs v5e
+    distinction a future profile may key on) but does not gate today —
+    geometry and backend do."""
+    key = {"workload": str(workload), "devices": int(devices),
+           "backend": str(backend)}
+    if device_kind:
+        key["device_kind"] = str(device_kind)
+    return key
+
+
+def build_profile(*, key: dict, knobs: Mapping[str, Any], claims: dict,
+                  objective: dict, provenance: dict,
+                  chaos_gate: dict | None = None,
+                  warnings: list[str] | None = None) -> dict:
+    """Assemble a schema-complete profile dict (the `tuned.json` payload).
+
+    Deliberately carries NO wall-clock timestamp: the acceptance contract
+    is that (seed, ledger) reproduce the profile bitwise, and a `now()`
+    stamp would break that for free. Freshness lives in the ledger file's
+    mtime and the archived trial rows' ``ts``.
+    """
+    unknown = sorted(set(knobs) - set(PROFILE_KNOBS))
+    if unknown:
+        raise ProfileError(
+            f"profile knobs {unknown} are not tunable config paths "
+            f"(known: {', '.join(PROFILE_KNOBS)})")
+    profile = {
+        "schema": PROFILE_SCHEMA,
+        "key": dict(key),
+        "config": dict(sorted(knobs.items())),
+        "config_hash": config_hash(knobs),
+        "objective": dict(objective),
+        "claims": dict(claims),
+        "provenance": dict(provenance),
+    }
+    if chaos_gate is not None:
+        profile["chaos_gate"] = dict(chaos_gate)
+    if warnings:
+        profile["warnings"] = list(warnings)
+    return profile
+
+
+def dump_profile(profile: dict, path: str | Path) -> None:
+    """Canonical serialization (sorted keys, 2-space indent, trailing
+    newline) — byte-identical output for equal payloads is what makes
+    the determinism tests meaningful."""
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    Path(path).write_text(
+        json.dumps(profile, indent=2, sort_keys=True) + "\n",
+        encoding="utf-8")
+
+
+def load_profile(path: str | Path) -> dict:
+    """Parse + validate a `tuned.json`; raises ProfileError with the exact
+    defect (never returns a half-valid profile)."""
+    p = Path(path)
+    try:
+        payload = json.loads(p.read_text(encoding="utf-8"))
+    except OSError as e:
+        raise ProfileError(f"cannot read profile {p}: {e}") from None
+    except json.JSONDecodeError as e:
+        raise ProfileError(f"profile {p} is not valid JSON: {e}") from None
+    if not isinstance(payload, dict):
+        raise ProfileError(f"profile {p} must be a JSON object")
+    schema = str(payload.get("schema", ""))
+    if not schema.startswith("tpu_dp.tune/profile/"):
+        raise ProfileError(
+            f"profile {p} has schema {schema!r}, expected "
+            f"{PROFILE_SCHEMA!r} (is this really a tuned.json?)")
+    if schema != PROFILE_SCHEMA:
+        raise ProfileError(
+            f"profile {p} has unsupported schema version {schema!r} "
+            f"(this build reads {PROFILE_SCHEMA!r})")
+    for field in ("key", "config", "claims"):
+        if not isinstance(payload.get(field), dict):
+            raise ProfileError(f"profile {p} is missing its {field!r} block")
+    key = payload["key"]
+    for field in ("workload", "devices", "backend"):
+        if field not in key:
+            raise ProfileError(f"profile {p} key lacks {field!r}")
+    unknown = sorted(set(payload["config"]) - set(PROFILE_KNOBS))
+    if unknown:
+        raise ProfileError(
+            f"profile {p} tunes unknown knobs {unknown} "
+            f"(known: {', '.join(PROFILE_KNOBS)})")
+    if payload.get("config_hash") != config_hash(payload["config"]):
+        raise ProfileError(
+            f"profile {p} config_hash does not match its config block — "
+            f"the knob set was edited without re-tuning")
+    return payload
+
+
+def check_key(profile: dict, *, workload: str, devices: int,
+              backend: str, where: str = "this run") -> None:
+    """Raise ProfileMismatchError unless the profile's key describes
+    (workload, devices, backend). One rule, three consumers: Trainer,
+    bench.py, and the serve CLI all refuse through here."""
+    key = profile.get("key", {})
+    problems = []
+    if str(key.get("workload")) != str(workload):
+        problems.append(
+            f"workload {key.get('workload')!r} != {workload!r}")
+    if int(key.get("devices", -1)) != int(devices):
+        problems.append(f"devices {key.get('devices')} != {devices}")
+    if str(key.get("backend")) != str(backend):
+        problems.append(f"backend {key.get('backend')!r} != {backend!r}")
+    if problems:
+        raise ProfileMismatchError(
+            f"profile is keyed for "
+            f"(workload={key.get('workload')!r}, "
+            f"devices={key.get('devices')}, "
+            f"backend={key.get('backend')!r}) but {where} is "
+            f"(workload={workload!r}, devices={devices}, "
+            f"backend={backend!r}): " + "; ".join(problems)
+            + " — re-run `python -m tpu_dp.tune` for this topology "
+              "instead of borrowing another one's numbers")
+
+
+def knob_value_str(value: Any) -> str:
+    """Render a profile knob for `Config.override` (the CLI coercion
+    path — one coercion code path for flags and profiles alike)."""
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    return str(value)
+
+
+def apply_profile(cfg, profile: dict,
+                  explicit: set[str] | frozenset[str] = frozenset()
+                  ) -> list[str]:
+    """Apply a loaded profile's knobs to a Config, skipping any dotted
+    path in ``explicit`` (flags the user set — precedence rule 1).
+    Returns the dotted paths actually applied, for logging."""
+    applied = []
+    for dotted, value in sorted(profile.get("config", {}).items()):
+        if dotted in explicit:
+            continue
+        cfg.override(dotted, knob_value_str(value))
+        applied.append(dotted)
+    return applied

@@ -1,0 +1,386 @@
+"""The deterministic search driver: grid + successive halving over
+fenced trials, with a ledger, a chaos gate, and a reproducible crown.
+
+Determinism contract (docs/TUNE.md "Reproducing a profile"): the entire
+search — trial order, promotions, tie-breaks, the winner — is a pure
+function of ``(seed, space, budget, ledger)``. The only RNG is
+``Random(f"{seed}:order")`` shuffling the hash-sorted grid; every
+ranking tie breaks on ``config_hash`` last, so there is no "whichever
+sorted first" left anywhere. Two runs with the same seed execute the
+identical trial sequence; a re-run over a populated ledger re-SCORES the
+cached records without re-running a single subprocess and emits a
+byte-identical ``tuned.json``.
+
+Successive halving (eta=2): every grid point runs the cheapest rung; the
+top half (by objective, exposed-comm tie-break) graduates to the next,
+bigger rung; repeat. The expensive fences are spent only on configs the
+cheap fences couldn't dismiss.
+
+The chaos gate runs LAST, over the final ranking: the top candidate must
+survive a pinned-seed composed-fault trial with its knobs compiled in
+(`tpu_dp.tune.gate`); a rejected candidate is recorded in the profile's
+``chaos_gate.rejected`` block and the crown moves down the ranking — a
+fast-but-fragile config loses to the best robust one, with receipts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import random
+from pathlib import Path
+from typing import Any, Callable, Mapping, Sequence
+
+from tpu_dp.obs.objective import (
+    TIE_FRAC,
+    TIEBREAK_SIGNAL,
+    is_tied,
+    objective_value,
+    tiebreak_value,
+    trial_signals,
+)
+from tpu_dp.tune import prior as prior_mod
+from tpu_dp.tune.profile import build_profile, config_hash, make_key
+from tpu_dp.tune.space import (
+    AUTO,
+    BUDGETS,
+    SearchSpace,
+    point_label,
+    rung_key,
+)
+
+LEDGER_NAME = "ledger.jsonl"
+
+#: How far down the final ranking the gate will walk before giving up —
+#: a topology where the top 3 configs all fail composed-fault recovery
+#: has a bug the tuner must surface, not paper over with rank #7.
+MAX_GATE_ATTEMPTS = 3
+
+#: The planted-fragile candidate's off-grid marker knob value. Chosen to
+#: be impossible to reach from any sane space (block sizes are powers of
+#: two in every documented sweep) so its config_hash can never collide
+#: with a real grid point.
+PLANTED_BLOCK_SIZE = 333
+
+
+class Ledger:
+    """Append-only trial memory over ``ledger.jsonl``.
+
+    One JSON object per line, three kinds:
+
+    - ``{"kind": "trial", "config_hash", "rung", "knobs", "record"}``
+    - ``{"kind": "probe", "rung", "record"}`` — the prior's probe
+    - ``{"kind": "gate", "config_hash", "verdict"}``
+
+    Lookups are exact on ``(kind, config_hash, rung)``; a resumed search
+    asking for a cached trial gets the recorded BENCH record back and
+    runs nothing. Corrupt lines are skipped on load (a crashed writer
+    must not poison the resume), never rewritten — the file is the
+    provenance artifact `tuned.json`'s ``ledger_sha256`` digests.
+    """
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._trials: dict[tuple[str, str], dict] = {}
+        self._probes: dict[str, dict] = {}
+        self._gates: dict[str, dict] = {}
+        self.hits = 0
+        self.misses = 0
+        if self.path.exists():
+            for line in self.path.read_text(encoding="utf-8").splitlines():
+                try:
+                    entry = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                self._index(entry)
+
+    def _index(self, entry: dict) -> None:
+        kind = entry.get("kind")
+        if kind == "trial":
+            self._trials[(entry["config_hash"], entry["rung"])] = \
+                entry["record"]
+        elif kind == "probe":
+            self._probes[entry["rung"]] = entry["record"]
+        elif kind == "gate":
+            self._gates[entry["config_hash"]] = entry["verdict"]
+
+    def _append(self, entry: dict) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(entry, sort_keys=True) + "\n")
+        self._index(entry)
+
+    def trial(self, knobs: Mapping[str, Any], rkey: str,
+              run: Callable[[], dict]) -> dict:
+        chash = config_hash(knobs)
+        cached = self._trials.get((chash, rkey))
+        if cached is not None:
+            self.hits += 1
+            return cached
+        self.misses += 1
+        record = run()
+        self._append({"kind": "trial", "config_hash": chash, "rung": rkey,
+                      "knobs": dict(sorted(knobs.items())),
+                      "record": record})
+        return record
+
+    def probe(self, rkey: str, run: Callable[[], dict]) -> dict:
+        cached = self._probes.get(rkey)
+        if cached is not None:
+            self.hits += 1
+            return cached
+        self.misses += 1
+        record = run()
+        self._append({"kind": "probe", "rung": rkey, "record": record})
+        return record
+
+    def gate(self, chash: str, run: Callable[[], dict]) -> dict:
+        cached = self._gates.get(chash)
+        if cached is not None:
+            self.hits += 1
+            return cached
+        self.misses += 1
+        verdict = run()
+        self._append({"kind": "gate", "config_hash": chash,
+                      "verdict": verdict})
+        return verdict
+
+    def digest(self) -> str:
+        """sha256 (12 hex) of the ledger file bytes — `tuned.json`'s
+        pointer to the exact trial evidence it was derived from."""
+        try:
+            blob = self.path.read_bytes()
+        except OSError:
+            blob = b""
+        return hashlib.sha256(blob).hexdigest()[:12]
+
+
+def rank(scored: Sequence[dict], tie_frac: float = TIE_FRAC) -> list[dict]:
+    """Deterministic ranking of ``[{knobs, record, score, tiebreak,
+    config_hash}]`` entries: score descending; scores within the tie
+    window compare on ``exposed_comm_ms`` ascending (less exposed wire
+    time = more headroom wins the tie); ``config_hash`` last so equal
+    evidence still orders identically everywhere. Unmeasured trials
+    (score None) rank after every measured one."""
+    import functools
+
+    def cmp(a: dict, b: dict) -> int:
+        sa, sb = a["score"], b["score"]
+        if sa is None and sb is None:
+            return -1 if a["config_hash"] < b["config_hash"] else 1
+        if sa is None:
+            return 1
+        if sb is None:
+            return -1
+        if not is_tied(sa, sb, tie_frac):
+            return -1 if sa > sb else 1
+        ta, tb = a["tiebreak"], b["tiebreak"]
+        if ta != tb:
+            return -1 if ta < tb else 1
+        return -1 if a["config_hash"] < b["config_hash"] else 1
+
+    return sorted(scored, key=functools.cmp_to_key(cmp))
+
+
+def _score(knobs: Mapping[str, Any], record: dict, objective: str) -> dict:
+    return {
+        "knobs": dict(knobs),
+        "config_hash": config_hash(knobs),
+        "record": record,
+        "score": objective_value(record, objective),
+        "tiebreak": tiebreak_value(record),
+    }
+
+
+def _planted_candidate(best: dict, objective: str) -> dict:
+    """The planted fast-but-fragile candidate of the self-test: a copy
+    of the current best whose score is SYNTHESIZED (never measured —
+    10x the best real number, an unearned leaderboard top) and whose
+    marker knob value keeps its hash off every real grid. Its chaos
+    gate runs against a tampered oracle, so the audit must reject it —
+    demonstrating the gate actually protects the crown."""
+    knobs = dict(best["knobs"])
+    knobs["train.quant_block_size"] = PLANTED_BLOCK_SIZE
+    record = dict(best["record"])
+    record = {k: v for k, v in record.items() if k != "ts"}
+    record["value"] = (best["record"].get("value") or 1.0) * 10
+    record["goodput"] = (best["record"].get("goodput") or 1.0) * 10
+    record["synthesized"] = True
+    entry = _score(knobs, record, objective)
+    entry["planted"] = True
+    return entry
+
+
+def run_search(*, seed: int, budget: str | Sequence[Mapping[str, int]],
+               space: SearchSpace,
+               runner: Callable[[Mapping[str, Any], Mapping[str, int]], dict],
+               workdir: str | Path,
+               objective: str = "throughput",
+               workload: str = "resnet18", devices: int | None = None,
+               backend: str | None = None, device_kind: str | None = None,
+               gate: Callable[..., dict] | None = None,
+               plant_fragile: bool = False,
+               extra_provenance: Mapping[str, Any] | None = None,
+               log=print) -> dict:
+    """The whole search; returns the assembled profile dict (unwritten —
+    the CLI owns the file). ``runner(knobs, rung) -> record`` runs one
+    fenced trial; ``gate(knobs, workdir, seed=..., tamper=...)`` runs
+    one chaos gate trial (None disables gating — tests and dry probes).
+    """
+    workdir = Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    ledger = Ledger(workdir / LEDGER_NAME)
+    rungs = BUDGETS[budget] if isinstance(budget, str) else list(budget)
+    budget_name = budget if isinstance(budget, str) else "custom"
+
+    # -- the bucket prior ----------------------------------------------
+    prior_info = None
+    if space.needs_prior:
+        probe_knobs = {k: (0.0 if k == "train.bucket_mb" else vs[0])
+                       for k, vs in space.knobs.items() if vs[0] != AUTO}
+        probe_knobs["train.bucket_mb"] = 0.0
+        rkey = "probe:" + rung_key(rungs[0])
+        log(f"tune: probing monolithic schedule for the bucket prior "
+            f"({point_label(probe_knobs)})")
+        probe = ledger.probe(rkey, lambda: runner(probe_knobs, rungs[0]))
+        candidates = prior_mod.bucket_candidates(probe)
+        prior_info = prior_mod.describe(probe, candidates)
+        space = space.with_bucket_candidates(candidates)
+        log(f"tune: prior sized train.bucket_mb candidates {candidates} "
+            f"from comm_ms={prior_info['comm_ms']} "
+            f"exposed={prior_info['exposed_comm_ms']}")
+
+    # -- the grid, in its seeded deterministic order -------------------
+    grid = space.enumerate()
+    grid.sort(key=config_hash)
+    random.Random(f"{seed}:order").shuffle(grid)  # str seed: stable
+    warnings: list[str] = []
+    for knobs in grid:
+        for w in space.coupling_flags(knobs):
+            tagged = f"{point_label(knobs)}: {w}"
+            if tagged not in warnings:
+                warnings.append(tagged)
+    log(f"tune: {len(grid)} grid points x {len(rungs)} rung(s), "
+        f"seed {seed}, objective {objective}")
+
+    # -- successive halving --------------------------------------------
+    survivors = grid
+    scored: list[dict] = []
+    for i, rung in enumerate(rungs):
+        rkey = rung_key(rung)
+        scored = []
+        for knobs in survivors:
+            record = ledger.trial(knobs, rkey,
+                                  lambda k=knobs, r=rung: runner(k, r))
+            entry = _score(knobs, record, objective)
+            scored.append(entry)
+            shown = ("FAILED" if entry["score"] is None
+                     else f"{entry['score']:.4g}")
+            log(f"tune: rung {rkey} {point_label(knobs)} "
+                f"{objective}={shown} "
+                f"{TIEBREAK_SIGNAL}={entry['tiebreak']:.4g}")
+        ranking = rank(scored)
+        if i < len(rungs) - 1:
+            keep = max(1, math.ceil(len(ranking) / 2))
+            survivors = [e["knobs"] for e in ranking[:keep]]
+            log(f"tune: rung {rkey} promotes {keep}/{len(ranking)} "
+                f"to {rung_key(rungs[i + 1])}")
+    finalists = rank(scored)
+    if all(e["score"] is None for e in finalists):
+        raise RuntimeError(
+            "tune: every trial failed — nothing to crown (see the "
+            "ledger's recorded errors)")
+
+    # -- the planted-fragile self-test candidate -----------------------
+    if plant_fragile:
+        planted = _planted_candidate(finalists[0], objective)
+        log(f"tune: planting fragile candidate "
+            f"{point_label(planted['knobs'])} with synthesized "
+            f"{objective}={planted['score']:.4g} (self-test)")
+        finalists = rank([planted] + finalists)
+
+    # -- the chaos gate over the final ranking -------------------------
+    gate_block: dict | None = None
+    winner = finalists[0]
+    if gate is not None:
+        gate_block = {"seed": seed, "rejected": []}
+        winner = None
+        for entry in finalists[:MAX_GATE_ATTEMPTS + int(plant_fragile)]:
+            if entry["score"] is None:
+                continue
+            chash = entry["config_hash"]
+            tamper = bool(entry.get("planted"))
+            verdict = ledger.gate(chash, lambda e=entry, t=tamper:
+                                  gate(e["knobs"],
+                                       workdir / f"gate_{e['config_hash']}",
+                                       seed=seed, tamper=t))
+            if verdict.get("ok"):
+                winner = entry
+                gate_block["verdict"] = verdict
+                break
+            gate_block["rejected"].append({
+                "config_hash": chash,
+                "label": point_label(entry["knobs"]),
+                "claimed_score": entry["score"],
+                "synthesized": bool(entry.get("planted")),
+                "failures": verdict.get("failures", []),
+            })
+            log(f"tune: gate rejected {point_label(entry['knobs'])} "
+                f"(claimed {objective}={entry['score']:.4g}) — "
+                f"crown moves down the ranking")
+        if winner is None:
+            raise RuntimeError(
+                f"tune: the top {MAX_GATE_ATTEMPTS} candidates all "
+                f"failed the chaos gate — fix the recovery path before "
+                f"tuning on top of it (rejections: "
+                f"{json.dumps(gate_block['rejected'])[:500]})")
+
+    # -- assemble the profile ------------------------------------------
+    claims = {k: v for k, v in trial_signals(winner["record"]).items()
+              if v is not None}
+    provenance = {
+        "seed": seed,
+        "budget": budget_name,
+        "rungs": [dict(r) for r in rungs],
+        "space": space.spec,
+        "grid_points": len(grid),
+        "trial_sequence": [config_hash(k) for k in grid],
+        # NOT in provenance: ledger hit/miss counts — they differ between
+        # a fresh run and its cached replay, and the contract is that the
+        # two emit byte-identical profiles.
+        "ledger_sha256": ledger.digest(),
+    }
+    if prior_info is not None:
+        provenance["bucket_prior"] = prior_info
+    if extra_provenance:
+        provenance.update(extra_provenance)
+    objective_block = {
+        "name": objective,
+        "value": winner["score"],
+        "tie_frac": TIE_FRAC,
+        "tiebreak": TIEBREAK_SIGNAL,
+        "tiebreak_value": (None if winner["tiebreak"] == float("inf")
+                          else winner["tiebreak"]),
+    }
+    # The key's geometry/backend come from the winner's OWN fenced record
+    # when the caller does not pin them — the trial subprocess saw the
+    # real mesh, and a profile must be keyed by what was measured.
+    wrec = winner["record"]
+    profile = build_profile(
+        key=make_key(
+            workload,
+            devices if devices is not None else wrec.get("n_chips", 0),
+            backend if backend is not None else wrec.get("backend", ""),
+            device_kind or wrec.get("device_kind")),
+        knobs=winner["knobs"],
+        claims=claims,
+        objective=objective_block,
+        provenance=provenance,
+        chaos_gate=gate_block,
+        warnings=warnings or None,
+    )
+    log(f"tune: crowned {point_label(winner['knobs'])} "
+        f"{objective}={winner['score']:.4g} "
+        f"(ledger: {ledger.hits} cached, {ledger.misses} run)")
+    return profile

@@ -1,0 +1,259 @@
+"""Declarative search space + budgets for the `tpu_dp.tune` driver.
+
+Grammar (docs/TUNE.md "Search space grammar"): a ``;``-separated list of
+``knob=v1,v2,...`` clauses. Knobs are dotted config paths
+(`train.bucket_mb`); the bare aliases the perf docs use (`bucket_mb`)
+resolve through `KNOB_ALIASES`. Values parse as JSON scalars where they
+can (``4`` -> int, ``0.05`` -> float) and stay strings otherwise
+(``int8``); an empty value (``collective_dtype=bf16,``) is the
+empty-string knob setting, i.e. "codec off".
+
+Two knob classes:
+
+- **executable** knobs change what a fenced bench trial measures
+  (`EXECUTABLE_KNOBS`). Only these may carry multiple candidates — the
+  driver refuses to "sweep" a knob whose trial score cannot see it,
+  because every such grid point would tie and the ranking would be a
+  coin flip wearing a leaderboard.
+- **pinned** knobs (one value) ride through the search untouched and
+  land in the profile's config block verbatim — how `serve.buckets` /
+  `serve.max_wait_ms` / `train.obs` get provenance-stamped into
+  `tuned.json` without pretending the training trial measured them.
+
+``train.bucket_mb=auto`` defers that axis to the analytic prior
+(`tpu_dp.tune.prior`): candidates are sized from a measured
+exposed-comm window instead of swept blind.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+from typing import Any, Mapping, Sequence
+
+from tpu_dp.config import coupling_warning
+from tpu_dp.tune.profile import PROFILE_KNOBS, config_hash
+
+
+class SpaceError(ValueError):
+    """A search-space spec the driver refuses to run."""
+
+
+#: docs/PERF.md shorthand -> dotted config path.
+KNOB_ALIASES = {
+    "bucket_mb": "train.bucket_mb",
+    "quant_block_size": "train.quant_block_size",
+    "collective_dtype": "train.collective_dtype",
+    "update_sharding": "train.update_sharding",
+    "obs": "train.obs",
+    "accum": "optim.grad_accum_steps",
+    "grad_accum_steps": "optim.grad_accum_steps",
+    "buckets": "serve.buckets",
+    "max_wait_ms": "serve.max_wait_ms",
+}
+
+#: Knobs the bench-backed trial actually exercises; only these may have
+#: more than one candidate (see module docstring).
+EXECUTABLE_KNOBS = frozenset((
+    "train.bucket_mb",
+    "train.quant_block_size",
+    "train.collective_dtype",
+    "train.update_sharding",
+))
+
+#: The default space of ISSUE 16's acceptance run:
+#: {bucket_mb x quant_block_size x collective_dtype} on the sharded
+#: update path, with the serve ladder pinned to its documented default
+#: so the profile is complete for every consumer.
+DEFAULT_SPACE = (
+    "train.update_sharding=sharded;"
+    "train.bucket_mb=auto;"
+    "train.quant_block_size=64,256;"
+    "train.collective_dtype=bf16,int8;"
+    "serve.buckets='1,2,4,8,16,32';"
+    "serve.max_wait_ms=5.0"
+)
+
+#: Sentinel candidate: this axis is filled in by the analytic prior.
+AUTO = "auto"
+
+
+def _parse_value(text: str) -> Any:
+    try:
+        v = json.loads(text)
+    except json.JSONDecodeError:
+        return text
+    # JSON true/false/null would type-mismatch every PROFILE_KNOB; the
+    # grammar has no boolean knobs, so keep such tokens as plain strings.
+    return text if isinstance(v, (bool, type(None))) else v
+
+
+def _split_candidates(text: str) -> list[str]:
+    """Comma-split, honoring quotes: the serve ladder is ITSELF a comma
+    string, so ``serve.buckets='1,2,4,8,16,32'`` must stay one value."""
+    out: list[str] = []
+    cur: list[str] = []
+    quote: str | None = None
+    for ch in text:
+        if quote is not None:
+            if ch == quote:
+                quote = None
+            else:
+                cur.append(ch)
+        elif ch in ("'", '"'):
+            quote = ch
+        elif ch == ",":
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if quote is not None:
+        raise SpaceError(f"unbalanced quote in {text!r}")
+    out.append("".join(cur).strip())
+    return out
+
+
+class SearchSpace:
+    """Parsed space: ordered {dotted knob -> candidate tuple}."""
+
+    def __init__(self, knobs: Mapping[str, Sequence[Any]]):
+        self.knobs: dict[str, tuple[Any, ...]] = {
+            k: tuple(v) for k, v in knobs.items()
+        }
+        for knob, values in self.knobs.items():
+            if knob not in PROFILE_KNOBS:
+                raise SpaceError(
+                    f"unknown knob {knob!r} (tunable: "
+                    f"{', '.join(PROFILE_KNOBS)})")
+            if not values:
+                raise SpaceError(f"knob {knob!r} has no candidates")
+            if len(values) > 1 and knob not in EXECUTABLE_KNOBS:
+                raise SpaceError(
+                    f"knob {knob!r} is pinned-only: the fenced trial "
+                    f"cannot measure it, so sweeping it would rank "
+                    f"identical scores (give it exactly one value)")
+            if AUTO in values and knob != "train.bucket_mb":
+                raise SpaceError(
+                    f"only train.bucket_mb supports 'auto' (the "
+                    f"exposed-comm prior); knob {knob!r} does not")
+
+    @classmethod
+    def parse(cls, spec: str) -> "SearchSpace":
+        knobs: dict[str, list[Any]] = {}
+        for clause in (spec or "").split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            name, sep, values = clause.partition("=")
+            if not sep:
+                raise SpaceError(
+                    f"clause {clause!r} is not knob=v1,v2,... ")
+            knob = KNOB_ALIASES.get(name.strip(), name.strip())
+            if knob in knobs:
+                raise SpaceError(f"knob {knob!r} given twice")
+            knobs[knob] = [_parse_value(v)
+                           for v in _split_candidates(values)]
+        if not knobs:
+            raise SpaceError("empty search space")
+        return cls(knobs)
+
+    @property
+    def spec(self) -> str:
+        """Canonical round-trippable spec string (provenance field)."""
+
+        def render(v: Any) -> str:
+            if isinstance(v, str):
+                return f"'{v}'" if "," in v else v
+            return json.dumps(v)
+
+        return ";".join(
+            f"{k}=" + ",".join(render(v) for v in vs)
+            for k, vs in self.knobs.items())
+
+    @property
+    def needs_prior(self) -> bool:
+        return AUTO in self.knobs.get("train.bucket_mb", ())
+
+    def with_bucket_candidates(self, candidates: Sequence[float]
+                               ) -> "SearchSpace":
+        """The space with `auto` resolved to the prior's candidates."""
+        knobs = dict(self.knobs)
+        resolved = []
+        for v in knobs.get("train.bucket_mb", ()):
+            if v == AUTO:
+                resolved.extend(c for c in candidates
+                                if c not in resolved)
+            elif v not in resolved:
+                resolved.append(v)
+        knobs["train.bucket_mb"] = tuple(resolved)
+        return SearchSpace(knobs)
+
+    def enumerate(self) -> list[dict[str, Any]]:
+        """The full deterministic grid: one resolved knob dict per point,
+        in lexicographic knob-declaration order. Raises if `auto` is
+        still unresolved — enumeration must never silently drop an axis.
+        """
+        if self.needs_prior:
+            raise SpaceError(
+                "train.bucket_mb=auto is unresolved — run the prior "
+                "(or pass explicit candidates) before enumerating")
+        names = list(self.knobs)
+        grid = []
+        for combo in itertools.product(*(self.knobs[n] for n in names)):
+            grid.append(dict(zip(names, combo)))
+        return grid
+
+    def coupling_flags(self, knobs: Mapping[str, Any]) -> list[str]:
+        """The shared config-time coupling rule, applied to one grid
+        point (satellite: tuner prior and hand-config path share ONE
+        rule — `tpu_dp.config.coupling_warning`)."""
+        warn = coupling_warning(
+            knobs.get("train.bucket_mb", 0.0),
+            knobs.get("train.quant_block_size", 0),
+            knobs.get("train.collective_dtype", ""))
+        return [warn] if warn else []
+
+
+def point_label(knobs: Mapping[str, Any]) -> str:
+    """Short human tag for logs: 'bucket1.0/block64/int8 [a1b2c3]'."""
+    parts = []
+    if "train.bucket_mb" in knobs:
+        parts.append(f"bucket{knobs['train.bucket_mb']}")
+    if "train.quant_block_size" in knobs:
+        parts.append(f"block{knobs['train.quant_block_size']}")
+    if "train.collective_dtype" in knobs:
+        parts.append(str(knobs["train.collective_dtype"]) or "f32")
+    return "/".join(parts) + f" [{config_hash(knobs)}]"
+
+
+# ---------------------------------------------------------------------------
+# budgets — the successive-halving rungs
+# ---------------------------------------------------------------------------
+
+#: budget name -> rung list. Each rung is the fenced-trial size every
+#: surviving candidate runs at; survivors of rung i (top 1/eta, eta=2)
+#: graduate to rung i+1. `latency_steps` also bounds the fenced-percentile
+#: pass; comm profiling is forced on by the trial runner regardless.
+BUDGETS: dict[str, list[dict[str, int]]] = {
+    # CI: one short rung — 3-config searches must finish inside a lane.
+    "tiny": [
+        {"measure_steps": 1, "latency_steps": 2},
+    ],
+    # The acceptance run: short fenced trials, survivors re-measured
+    # at a 3x budget before the chaos gate.
+    "small": [
+        {"measure_steps": 2, "latency_steps": 3},
+        {"measure_steps": 6, "latency_steps": 6},
+    ],
+    # Real tuning on a live accelerator.
+    "full": [
+        {"measure_steps": 5, "latency_steps": 10},
+        {"measure_steps": 15, "latency_steps": 20},
+        {"measure_steps": 30, "latency_steps": 20},
+    ],
+}
+
+
+def rung_key(rung: Mapping[str, int]) -> str:
+    """Ledger cache key component for one rung's trial size."""
+    return f"m{rung['measure_steps']}l{rung['latency_steps']}"
