@@ -1,0 +1,42 @@
+"""Adversarial dplint fixture — DP401: protocol-seam IO outside the route.
+
+The broken publish writes the ledger pointer bare: a transient EIO loses
+the publish, and the chaos harness's storage-fault shim never sees the
+seam (the PR 14 fault-that-never-fires shape). The routed twin hands the
+write to the retry router with the shim consulted inside the retried
+block; the audited twin carries the allow-pragma.
+"""
+
+from pathlib import Path
+
+from tpu_dp.resilience.retry import retry_call
+
+
+def _storage_shim():
+    return None  # stand-in for faultinject.storage_shim
+
+
+def _ledger_io(fn, describe: str):
+    # A local one-level wrapper: DP401 must discover this as a router
+    # because its body calls retry_call.
+    return retry_call(fn, retries=3, retry_on=(OSError,), describe=describe)
+
+
+def broken_publish(ledger_dir: Path, epoch: int) -> None:
+    ptr = ledger_dir / "latest.tmp"
+    ptr.write_text(str(epoch))  # EXPECT: DP401
+
+
+def routed_publish(ledger_dir: Path, epoch: int) -> None:
+    def _write():
+        shim = _storage_shim()
+        if shim is not None:
+            shim.on_write(ledger_dir / "latest")
+        (ledger_dir / "latest").write_text(str(epoch))
+
+    _ledger_io(_write, f"publish latest={epoch}")
+
+
+def audited_marker(ledger_dir: Path) -> None:
+    # dplint: allow(DP401) advisory marker outside the IO protocol
+    (ledger_dir / "seen.marker").touch()

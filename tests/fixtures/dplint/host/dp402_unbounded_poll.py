@@ -1,0 +1,37 @@
+"""Adversarial dplint fixture — DP402: unbounded blocking poll.
+
+The broken wait polls a barrier directory forever: when a peer died
+before acking, this process wedges with it. The bounded twin derives a
+monotonic deadline from the config timeout; the audited twin is a
+run-forever service loop bounded by its stop flag.
+"""
+
+import time
+from pathlib import Path
+
+
+def broken_wait_for_acks(acks_dir: Path, expected: int) -> None:
+    while True:
+        if len(list(acks_dir.glob("*.done"))) >= expected:
+            return
+        time.sleep(0.05)  # EXPECT: DP402
+
+
+def bounded_wait_for_acks(acks_dir: Path, expected: int,
+                          timeout_s: float) -> None:
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if len(list(acks_dir.glob("*.done"))) >= expected:
+            return
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"{expected} acks not seen in {timeout_s}s")
+        time.sleep(0.05)
+
+
+def audited_service_loop(stop, work) -> None:
+    # dplint: allow(DP402) flag-bounded service loop, no natural deadline
+    while True:
+        if stop.is_set():
+            return
+        time.sleep(0.05)
+        work()

@@ -1,0 +1,28 @@
+"""Adversarial dplint fixture — DP403: wall-clock deadline arithmetic.
+
+The broken budget derives a deadline from `time.time()`: an NTP step
+stretches or collapses it silently. The monotonic twin is the fix; the
+data-stamp function shows the deliberate non-finding (wall-clock as
+recorded data, not arithmetic); the audited twin compares against an
+external wall-clock stamp on purpose.
+"""
+
+import time
+
+
+def broken_budget(timeout_s: float) -> float:
+    return time.time() + timeout_s  # EXPECT: DP403
+
+
+def monotonic_budget(timeout_s: float) -> float:
+    return time.monotonic() + timeout_s
+
+
+def stamped_record(reason: str) -> dict:
+    # Wall-clock as DATA is fine: no Compare/BinOp, no finding.
+    return {"reason": reason, "ts": time.time()}
+
+
+def audited_cross_process_expiry(stamp_from_ledger: float) -> bool:
+    # dplint: allow(DP403) comparing an external wall-clock stamp
+    return time.time() >= stamp_from_ledger

@@ -1,0 +1,28 @@
+"""Adversarial dplint fixture — DP404: flightrec event-kind drift.
+
+Three drift shapes: an emit of a kind the registry has never heard of, a
+rendered marker kind that is not registered, and a registered marker kind
+no analyzed emit site publishes (dead forensics — the pre-registry
+``dump_request`` bug). The registered emit and the pragma'd twin stay
+clean.
+"""
+
+from tpu_dp.obs import flightrec
+
+MARKER_KINDS = (
+    "guard_rollback",
+    "zorble_rendered",  # EXPECT: DP404
+    "profile_start",  # EXPECT: DP404
+)
+
+
+def broken_emit(step: int) -> None:
+    flightrec.record("zorble_event", step=step)  # EXPECT: DP404
+
+
+def registered_emit(step: int) -> None:
+    flightrec.record("guard_rollback", step=step)
+
+
+def audited_emit(step: int) -> None:
+    flightrec.record("zorble_local", step=step)  # dplint: allow(DP404)

@@ -1,0 +1,24 @@
+"""Adversarial dplint fixture — DP405: counter/gauge name drift.
+
+The broken increment names a metric the registry has never heard of — an
+obsctl diff/watch signal naming it would silently never fire. The
+registered, family-prefixed, and pragma'd twins stay clean.
+"""
+
+from tpu_dp.obs.counters import counters
+
+
+def broken_inc() -> None:
+    counters.inc("zorble.count")  # EXPECT: DP405
+
+
+def registered_inc() -> None:
+    counters.inc("retry.attempts")
+
+
+def family_gauge(sid: int) -> None:
+    counters.gauge(f"serve.replica_health.{sid}", 1.0)
+
+
+def audited_inc() -> None:
+    counters.inc("zorble.audited")  # dplint: allow(DP405)

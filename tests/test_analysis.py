@@ -81,10 +81,21 @@ def test_fixture_fires_expected_rule(fixture, capsys):
 
 
 def test_all_rules_covered_by_fixtures():
-    """Every documented rule has at least one adversarial fixture."""
+    """Every documented rule has at least one adversarial fixture.
+
+    Level-4 host-protocol fixtures live in the `host/` subdirectory
+    (driven by `tests/test_hostproto.py` through the `host` subcommand,
+    not the device-program CLI this file exercises) but count toward the
+    same one-fixture-per-rule contract.
+    """
     covered = set()
-    for fixture in FIXTURE_FILES:
-        for rule, _ in _expected_findings(os.path.join(FIXTURES, fixture)):
+    host_dir = os.path.join(FIXTURES, "host")
+    paths = [os.path.join(FIXTURES, f) for f in FIXTURE_FILES] + [
+        os.path.join(host_dir, f) for f in sorted(os.listdir(host_dir))
+        if f.endswith(".py")
+    ]
+    for path in paths:
+        for rule, _ in _expected_findings(path):
             covered.add(rule)
     assert covered == set(RULES), (
         f"rules without a fixture: {set(RULES) - covered}"

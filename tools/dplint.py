@@ -8,6 +8,9 @@ without installing the package:
     tools/dplint.py --no-jaxpr --no-hlo path   # AST rules only (pre-commit)
     tools/dplint.py --baseline ci.json # suppress pre-existing findings
     tools/dplint.py --list-rules
+    tools/dplint.py host               # Level 4: host-protocol rules
+                                       # (DP401-DP405) over the tree
+    tools/dplint.py host --list-rules  # the Level-4 rule table
 
 Equivalent to `python -m tpu_dp.analysis`. Exit 0 clean / 1 findings /
 2 internal or usage error (partial findings still rendered on stdout).

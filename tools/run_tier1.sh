@@ -16,6 +16,12 @@
 #                                    # finding. Emits artifacts/
 #                                    # dplint_report.json and artifacts/
 #                                    # collective_fingerprint.json.
+#   tools/run_tier1.sh --lint        # host-protocol lane: dplint Level 4
+#                                    # (DP401-DP405) over the tree (must
+#                                    # be clean; archives artifacts/
+#                                    # hostproto_report.json), a planted
+#                                    # tampered fixture that MUST fail,
+#                                    # then the -m lint tests.
 #   tools/run_tier1.sh --obs         # telemetry lane: a 10-step obs=full
 #                                    # smoke run (archives its metrics.jsonl
 #                                    # and Perfetto trace under artifacts/)
@@ -217,6 +223,40 @@ if [ "${1:-}" = "--dplint" ]; then
         exit "$rc"
     fi
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m analysis \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--lint" ]; then
+    # Level 4 host-protocol lane (DP401-DP405), both directions:
+    # 1. the shipped tree must lint clean (exit 0, report archived);
+    # 2. a tampered fixture copy planted into a scratch package MUST
+    #    exit 1 — proving the gate still bites, not just that the tree
+    #    is quiet;
+    # 3. the -m lint pytest suite (fixtures fire exactly, engine
+    #    boundaries, registry invariants).
+    mkdir -p artifacts
+    env JAX_PLATFORMS=cpu python -m tpu_dp.analysis host --json \
+        > artifacts/hostproto_report.json
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        cat artifacts/hostproto_report.json
+        echo "run_tier1 --lint: shipped tree is not hostproto-clean" >&2
+        exit "$rc"
+    fi
+    SCRATCH=$(mktemp -d /tmp/tpu_dp_lint_scratch.XXXXXX) || exit 1
+    mkdir -p "$SCRATCH/scratchpkg"
+    : > "$SCRATCH/scratchpkg/__init__.py"
+    cp tests/fixtures/dplint/host/dp401_unrouted_io.py \
+        "$SCRATCH/scratchpkg/ledger.py"
+    if env JAX_PLATFORMS=cpu python -m tpu_dp.analysis host "$SCRATCH" \
+        > /dev/null; then
+        echo "run_tier1 --lint: planted DP401 fixture did NOT fail the" \
+             "gate — the lint lane is toothless" >&2
+        rm -rf "$SCRATCH"
+        exit 1
+    fi
+    rm -rf "$SCRATCH"
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m lint \
         -p no:cacheprovider
 fi
 

@@ -9,6 +9,10 @@ Runs three levels over the given paths:
   (`tpu_dp/train/step.py`), the real per-shard step is traced and verified
   for every `--accum-steps` variant; a standalone .py defining
   `DPLINT_LOCAL_STEP` is imported and its step verified the same way.
+- **Level 4 (host protocol, via the `host` subcommand)**: DP401–DP405
+  (`tpu_dp.analysis.hostproto`) — IO-seam routing, unbounded polls,
+  wall-clock deadlines, flightrec kind and counter name drift. Runs as
+  `python -m tpu_dp.analysis host [paths...]`; pure AST, no jax.
 - **Level 3 (HLO, unless --no-hlo)**: the compiled-artifact pass
   (DP301–DP304). The shipped step programs are lowered and compiled on an
   abstract `--world`-device data mesh and the optimized HLO is verified
@@ -116,7 +120,99 @@ def _setup_backend(world: int) -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
+def host_main(argv: list[str]) -> int:
+    """`python -m tpu_dp.analysis host [paths...]`: the Level-4 pass.
+
+    Runs only DP401–DP405 (`tpu_dp.analysis.hostproto`) — pure AST, no
+    jax, no tracing — over the given paths (default: the whole tpu_dp
+    package, so DP404's rendered-kind-is-emitted check sees the real
+    emit sites in train/ and utils/, not just the protocol packages the
+    findings are scoped to). Shares the report/baseline/pragma
+    machinery and exit codes with the main driver.
+    """
+    parser = argparse.ArgumentParser(
+        prog="dplint host",
+        description="host-protocol static analysis (DP401-DP405): "
+                    "IO-seam routing, unbounded polls, wall-clock "
+                    "deadlines, flightrec kind and counter name drift",
+    )
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or directories to analyze "
+                             "(default: the tpu_dp package)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--baseline", default=None, metavar="FILE",
+                        help="suppress findings whose fingerprint "
+                             "(rule+path+symbol) appears in FILE")
+    parser.add_argument("--write-baseline", default=None, metavar="FILE",
+                        help="write the current findings' fingerprints to "
+                             "FILE and exit 0")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the Level-4 rule table and exit")
+    args = parser.parse_args(argv)
+
+    from tpu_dp.analysis import hostproto
+    from tpu_dp.analysis.report import RULES
+
+    if args.list_rules:
+        lines = []
+        for rule, (title, failure) in RULES.items():
+            if rule.startswith("DP4"):
+                lines.append(f"{rule}  {title}")
+                lines.append(f"       {failure}")
+        print("\n".join(lines))
+        return 0
+
+    suppressed: set[str] = set()
+    if args.baseline is not None:
+        try:
+            suppressed = load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"dplint: bad --baseline: {e}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or [os.path.join(_repo_root(), "tpu_dp")]
+    findings: list[Finding] = []
+    internal_error: str | None = None
+    try:
+        findings = hostproto.lint_paths(paths)
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print("dplint: internal error (partial findings on stdout)",
+              file=sys.stderr)
+        internal_error = f"{type(e).__name__}: {e}"
+
+    all_findings = findings
+    findings = apply_baseline(findings, suppressed)
+    if args.write_baseline is not None:
+        if internal_error:
+            print("dplint: refusing to write baseline from partial "
+                  "findings (internal error above)", file=sys.stderr)
+            print(render_json(findings, error=internal_error) if args.json
+                  else render_text(findings, error=internal_error))
+            return 2
+        n = write_baseline(args.write_baseline, all_findings)
+        print(f"dplint: wrote {n} fingerprint(s) to {args.write_baseline}",
+              file=sys.stderr)
+        return 0
+
+    print(render_json(findings, error=internal_error) if args.json
+          else render_text(findings, error=internal_error))
+    if internal_error:
+        return 2
+    return 1 if findings else 0
+
+
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # `dplint host ...` dispatches to the Level-4 host-protocol pass
+    # before the device-program parser sees the argv (it has its own
+    # flag surface and never touches jax).
+    if argv and argv[0] == "host":
+        return host_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="dplint",
         description="static SPMD-correctness analyzer for tpu_dp "

@@ -1,0 +1,784 @@
+"""Level-4 dplint: host-protocol rules DP401–DP405 over the control plane.
+
+Levels 1–3 prove the *device* program correct; every wedge the chaos
+harness has found since PR 12 lived in *host* protocol code — the
+membership ledger, the checkpoint write protocol, the serving loops, the
+forensic timeline. These rules encode those shipped-and-fixed bug
+classes so the next one is a lint failure, not a chaos-trial discovery:
+
+- DP401 — **unrouted protocol IO**: a filesystem write primitive
+  (``open(mode="w"/"a"/...)``, ``.write_text``/``.write_bytes``,
+  ``.touch``, ``os.replace``/``rename``/``link``/``unlink``) in a durable-
+  protocol module (``resilience/``, ``checkpoint.py``) whose enclosing
+  function neither consults the storage-fault shim accessor
+  (`faultinject.storage_shim` — the seam chaos trials inject through)
+  nor is handed to the unified retry router (`retry_call`, or a local
+  wrapper around it like ``_ledger_io``/``_io_retry``, discovered one
+  call level deep). An unrouted seam is the PR 14 fault-that-never-fires
+  bug: the chaos harness believes it exercised the write, and didn't.
+- DP402 — **unbounded blocking poll**: a ``while`` loop whose body
+  blocks (``time.sleep``, ``.wait(...)``, a zero-argument ``.get()``, a
+  bare ``.acquire()``/``.join()``) with no monotonic deadline
+  (`time.monotonic`/`time.perf_counter`) dominating the loop — proven
+  by a deadline comparison in the loop itself or, one level deep, in a
+  same-module function the body calls every turn (the
+  ``quiesce_blocking``→``quiesce_step`` shape). Stop-flag loops that
+  block only in the loop *test* (``while not stop.wait(t):``) are
+  bounded by their flag and exempt by construction.
+- DP403 — **wall-clock deadline arithmetic**: ``time.time()`` (or
+  ``datetime.now``/``utcnow``) used directly inside a comparison or a
+  ``+``/``-`` expression. Deadlines and durations must come from the
+  monotonic clock — an NTP step under a multi-hour run silently
+  stretches or collapses every quiesce budget. Wall-clock *data* stamps
+  (``{"ts": time.time()}``, function arguments, heartbeat payloads) are
+  deliberately not flagged: the rule looks only at arithmetic, so
+  cross-process timestamp bookkeeping (`obs/health.py`) stays clean.
+- DP404 — **flightrec event-kind drift**: every emitted event kind (a
+  literal first argument to ``*.record(...)``, an ``{"event": ...}``
+  metrics record, or an obsctl timeline synthesis site) must be declared
+  in the single-source registry `tpu_dp.obs.flightrec.KINDS`, and every
+  kind the timeline *renders* (``MARKER_KINDS``/``_REPLICATED_KINDS``)
+  must be registered AND emitted somewhere in the analyzed tree — a
+  renderer waiting for a kind nobody publishes is dead forensics.
+- DP405 — **counter/gauge name drift**: every literal (or f-string-
+  prefixed) name at a ``.inc(...)``/``.gauge(...)`` site must be
+  declared in `tpu_dp.obs.counters.METRICS` (exact) or
+  `METRIC_FAMILIES` (dynamic-suffix prefix), so an obsctl diff/watch
+  signal can never silently reference a counter nothing publishes.
+
+Scoping: rules self-scope by path. Files under the ``tpu_dp`` package
+are checked against the protocol-package map below (DP401 only in the
+durable-protocol modules; DP402/DP403 across the host control plane;
+DP404/DP405 everywhere — emit sites live in ``train/`` too). Files
+*outside* the package (adversarial fixtures, scratch copies) get every
+rule — a planted violation must fire wherever CI plants it.
+
+Suppression uses the shared ``# dplint: allow(DP4xx)`` pragma machinery;
+`python -m tpu_dp.analysis host` is the CLI entry (exit 0 clean / 1
+findings / 2 internal), and ``tools/run_tier1.sh --lint`` is the CI lane
+enforcing both directions. docs/ANALYSIS.md "Level 4 — host protocol"
+is the prose contract, real found-and-fixed citations included.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+from tpu_dp.analysis import pragmas
+from tpu_dp.analysis.astlint import (
+    _dotted,
+    iter_py_files,
+    scope_at,
+    scope_index,
+)
+from tpu_dp.analysis.report import Finding
+
+# --------------------------------------------------------------------------
+# scoping
+# --------------------------------------------------------------------------
+
+#: package-relative prefixes forming the durable-protocol IO scope (DP401):
+#: the modules whose writes ARE the crash-consistency protocol. Telemetry
+#: writers (obs/), report writers (chaos/, serve/) are deliberately out —
+#: their writes are evidence, not protocol state, and `obs/_atomic.py`
+#: already gives them tmp+rename without a retry budget.
+_DP401_PREFIXES = ("resilience/", "checkpoint.py")
+
+#: package-relative prefixes forming the host-protocol scope (DP402/DP403):
+#: everything multi-process coordination flows through.
+_HOST_PREFIXES = (
+    "resilience/", "serve/", "chaos/", "obs/", "checkpoint.py",
+    "data/pipeline.py",
+)
+
+#: modules that ARE the retry/fault-injection machinery: DP401 routes
+#: writes *to* them, so their own internals are exempt from it.
+_MACHINERY = ("resilience/retry.py", "resilience/faultinject.py",
+              "chaos/storage.py")
+
+
+def _pkg_rel(path: str) -> str | None:
+    """Path relative to the ``tpu_dp`` package (posix), or None if outside."""
+    p = os.path.abspath(path).replace(os.sep, "/")
+    marker = "/tpu_dp/"
+    idx = p.rfind(marker)
+    if idx < 0:
+        return None
+    return p[idx + len(marker):]
+
+
+def _in_scope(path: str, prefixes: tuple[str, ...]) -> bool:
+    rel = _pkg_rel(path)
+    if rel is None:
+        return True  # fixtures / scratch copies: every rule applies
+    return rel.startswith(prefixes)
+
+
+def dp401_applies(path: str) -> bool:
+    rel = _pkg_rel(path)
+    if rel is not None and rel.startswith(_MACHINERY):
+        return False
+    return _in_scope(path, _DP401_PREFIXES)
+
+
+def host_applies(path: str) -> bool:
+    return _in_scope(path, _HOST_PREFIXES)
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+# --------------------------------------------------------------------------
+
+_SHIM_ACCESSORS = {"storage_shim", "_storage_shim", "_chaos_shim"}
+_SHIM_SEAMS = {"on_write", "on_read", "post_commit"}
+_WRITE_ATTRS = {"write_text", "write_bytes", "touch"}
+_FS_FUNCS = {"replace", "rename", "renames", "link", "unlink", "remove"}
+_MONO_FUNCS = {"monotonic", "monotonic_ns", "perf_counter",
+               "perf_counter_ns"}
+_WALL_TIME_FUNCS = {"time", "time_ns"}
+_BLOCKING_SLEEP = {"sleep"}
+
+
+def _last(dotted: str | None) -> str | None:
+    return None if dotted is None else dotted.rsplit(".", 1)[-1]
+
+
+def _time_aliases(tree: ast.Module) -> tuple[set[str], dict[str, str]]:
+    """(module aliases of ``time``, from-imported name -> original).
+
+    Handles ``import time``, ``import time as _time`` and
+    ``from time import monotonic as mono`` so obsctl's ``_time.time()``
+    is recognized the same as a plain ``time.time()``.
+    """
+    mod_aliases: set[str] = set()
+    from_names: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mod_aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                from_names[a.asname or a.name] = a.name
+    mod_aliases.add("time")  # `import time as _time` inside a function body
+    return mod_aliases, from_names
+
+
+class _Clocks:
+    """Classify calls as monotonic-clock or wall-clock reads."""
+
+    def __init__(self, tree: ast.Module):
+        self.mod_aliases, self.from_names = _time_aliases(tree)
+
+    def _time_func(self, call: ast.Call) -> str | None:
+        """'monotonic'/'time'/... when ``call`` reads a clock, else None."""
+        func = call.func
+        if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                          ast.Name):
+            if func.value.id in self.mod_aliases:
+                return func.attr
+            return None
+        if isinstance(func, ast.Name):
+            return self.from_names.get(func.id)
+        return None
+
+    def is_monotonic(self, call: ast.Call) -> bool:
+        return self._time_func(call) in _MONO_FUNCS
+
+    def is_wall(self, call: ast.Call) -> bool:
+        if self._time_func(call) in _WALL_TIME_FUNCS:
+            return True
+        dotted = _dotted(call.func)
+        if dotted is None:
+            return False
+        parts = dotted.split(".")
+        return parts[-1] in ("now", "utcnow") and "datetime" in parts
+
+
+def _function_index(tree: ast.Module) -> list[ast.AST]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _enclosing_function(tree: ast.Module, node: ast.AST) -> ast.AST | None:
+    """Innermost def containing ``node`` (by position), or None (module).
+
+    ``node`` itself is excluded from the candidates: for a def node this
+    must return the def's PARENT function (a closure's own span contains
+    its ``def`` line, and answering "itself" made router resolution
+    check whether the router call sits inside the routed closure — it
+    never does, so pure retry-routing silently stopped matching).
+    """
+    best = None
+    best_span = None
+    line = node.lineno
+    end = getattr(node, "end_lineno", line) or line
+    for fn in _function_index(tree):
+        if fn is node:
+            continue
+        f_end = fn.end_lineno or fn.lineno
+        if fn.lineno <= line and end <= f_end:
+            span = f_end - fn.lineno
+            if best_span is None or span < best_span:
+                best, best_span = fn, span
+    return best
+
+
+def _walk_skipping_defs(nodes: Iterable[ast.AST]):
+    """Walk statements without descending into nested function bodies —
+    a closure defined inside a loop runs on its own schedule, not the
+    loop's, so its calls are not the loop's calls."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------------
+# the per-file linter
+# --------------------------------------------------------------------------
+
+
+class _HostLinter:
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.allowed = pragmas.collect(source)
+        self.findings: list[Finding] = []
+        self._scopes: list[tuple[int, int, str]] = []
+        # cross-file DP404 state, harvested by lint_paths():
+        self.emitted_kinds: dict[str, int] = {}    # kind -> first emit line
+        self.rendered_kinds: list[tuple[str, str, int]] = []  # (kind, set, ln)
+
+    def _emit(self, rule: str, line: int, message: str,
+              extra_lines: tuple[int, ...] = ()) -> None:
+        if pragmas.is_allowed(self.allowed, rule, (line,) + extra_lines):
+            return
+        self.findings.append(Finding(
+            rule, self.path, line, message,
+            symbol=scope_at(self._scopes, line),
+        ))
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.source, filename=self.path)
+        except SyntaxError as e:
+            self.findings.append(Finding(
+                "DP100", self.path, e.lineno or 1,
+                f"file does not parse: {e.msg}",
+            ))
+            return self.findings
+        self._scopes = scope_index(tree)
+        self._tree = tree
+        self._clocks = _Clocks(tree)
+
+        if dp401_applies(self.path):
+            self._check_dp401(tree)
+        if host_applies(self.path):
+            self._check_dp402(tree)
+            self._check_dp403(tree)
+        # Emit-site registration (DP404/DP405) applies to every analyzed
+        # file: the train/ and utils/ layers emit into the same registry.
+        self._collect_and_check_kinds(tree)
+        self._check_dp405(tree)
+        return self.findings
+
+    # -- DP401: unrouted protocol IO -----------------------------------
+
+    def _retry_routers(self, tree: ast.Module) -> set[str]:
+        """`retry_call` plus every local function whose body calls it —
+        the one-level interprocedural discovery that recognizes
+        ``elastic._ledger_io`` and ``checkpoint._io_retry`` as routers."""
+        routers = {"retry_call"}
+        for fn in _function_index(tree):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        _last(_dotted(node.func)) == "retry_call":
+                    routers.add(fn.name)
+                    break
+        return routers
+
+    def _routed_functions(self, tree: ast.Module,
+                          routers: set[str]) -> set[int]:
+        """Node ids of function defs passed by name into a retry-router
+        call. Resolution is scope-aware on purpose: two closures named
+        ``_write`` in different functions are different functions, and
+        `_io_retry(_write)` inside one must not launder the other — that
+        exact aliasing is how the unrouted latest-pointer publish in
+        `CheckpointManager.save` hid from the first draft of this rule.
+        """
+        defs_by_name: dict[str, list[ast.AST]] = {}
+        for fn in _function_index(tree):
+            defs_by_name.setdefault(fn.name, []).append(fn)
+
+        def _resolve(name: str, call: ast.Call, attr: bool) -> None:
+            for d in defs_by_name.get(name, ()):
+                if attr:
+                    # self._write / obj.method: dynamic dispatch — any
+                    # same-named def may be the target.
+                    routed.add(id(d))
+                    continue
+                parent = _enclosing_function(tree, d)
+                if parent is None:
+                    routed.add(id(d))  # module-level def, module-wide name
+                    continue
+                p_end = parent.end_lineno or parent.lineno
+                if parent.lineno <= call.lineno <= p_end:
+                    routed.add(id(d))  # closure referenced from its scope
+
+        routed: set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _last(_dotted(node.func)) not in routers:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    _resolve(arg.id, node, attr=False)
+                elif isinstance(arg, ast.Attribute):
+                    _resolve(arg.attr, node, attr=True)
+        return routed
+
+    @staticmethod
+    def _consults_shim(fn: ast.AST | None) -> bool:
+        if fn is None:
+            return False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                last = _last(_dotted(node.func))
+                if last in _SHIM_ACCESSORS or last in _SHIM_SEAMS:
+                    return True
+        return False
+
+    def _write_primitive(self, call: ast.Call) -> str | None:
+        """Describe ``call`` when it is a filesystem write primitive."""
+        func = call.func
+        dotted = _dotted(func)
+        last = _last(dotted)
+        if last == "open" and (dotted in ("open", "io.open")
+                               or isinstance(func, ast.Name)):
+            mode = None
+            if len(call.args) >= 2:
+                mode = call.args[1]
+            for kw in call.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if mode is None:
+                return None  # default "r": read-only
+            if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+                if not any(c in mode.value for c in "wax+"):
+                    return None
+                return f"open(..., {mode.value!r})"
+            return "open(..., <dynamic mode>)"
+        if isinstance(func, ast.Attribute):
+            if func.attr in _WRITE_ATTRS:
+                return f".{func.attr}()"
+            if func.attr in _FS_FUNCS:
+                base = _dotted(func.value)
+                if base == "os" or base is None or not base[:1].isupper():
+                    # os.replace / Path.rename-style; skip Class.method refs
+                    return f"{base or '<expr>'}.{func.attr}()"
+        return None
+
+    def _check_dp401(self, tree: ast.Module) -> None:
+        routers = self._retry_routers(tree)
+        routed_names = self._routed_functions(tree, routers)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what = self._write_primitive(node)
+            if what is None:
+                continue
+            fn = _enclosing_function(tree, node)
+            if fn is not None and id(fn) in routed_names:
+                continue  # the whole helper runs under the retry budget
+            if self._consults_shim(fn):
+                continue  # the seam is visible to fault injection
+            fn_name = fn.name if fn is not None else "<module>"
+            self._emit(
+                "DP401", node.lineno,
+                f"protocol-seam write `{what}` in `{fn_name}` is routed "
+                f"through neither `retry_call` (a transient EIO here is a "
+                f"lost publish) nor the `faultinject.storage_shim` seam "
+                f"(chaos trials cannot fault-inject it) — wrap it in a "
+                f"helper handed to the IO retry router and consult the "
+                f"shim accessor inside the retried block, or audit with "
+                f"`# dplint: allow(DP401)`",
+                extra_lines=(node.lineno - 1,),
+            )
+
+    # -- DP402: unbounded blocking poll --------------------------------
+
+    def _blocking_call(self, call: ast.Call) -> str | None:
+        tf = self._clocks._time_func(call)
+        if tf in _BLOCKING_SLEEP:
+            return "time.sleep"
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        if func.attr == "wait":
+            return f".wait()"
+        if func.attr == "acquire" and not call.args and not call.keywords:
+            return ".acquire()"
+        if func.attr == "join" and not call.args and not call.keywords:
+            return ".join()"
+        if func.attr == "get" and not call.args and not any(
+            kw.arg == "timeout" for kw in call.keywords
+        ):
+            return ".get()"
+        return None
+
+    def _mono_derived_names(self, fn: ast.AST | None) -> set[str]:
+        """Names in ``fn`` assigned (transitively) from a monotonic read:
+        ``deadline = time.monotonic() + t`` taints ``deadline``; a later
+        ``end = deadline - slack`` taints ``end`` too."""
+        if fn is None:
+            return set()
+        assignments: list[tuple[set[str], ast.AST]] = []
+        for node in ast.walk(fn):
+            targets: list[ast.AST] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.NamedExpr):
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            names = {t.id for t in targets if isinstance(t, ast.Name)}
+            if names:
+                assignments.append((names, value))
+        tainted: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for names, value in assignments:
+                if names <= tainted:
+                    continue
+                hit = False
+                for sub in ast.walk(value):
+                    if isinstance(sub, ast.Call) and \
+                            self._clocks.is_monotonic(sub):
+                        hit = True
+                    elif isinstance(sub, ast.Name) and sub.id in tainted:
+                        hit = True
+                if hit:
+                    tainted |= names
+                    changed = True
+        return tainted
+
+    def _has_deadline_compare(self, nodes: Iterable[ast.AST],
+                              tainted: set[str]) -> bool:
+        for node in _walk_skipping_defs(nodes):
+            if not isinstance(node, ast.Compare):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        self._clocks.is_monotonic(sub):
+                    return True
+                if isinstance(sub, ast.Name) and sub.id in tainted:
+                    return True
+        return False
+
+    def _local_callables(self, tree: ast.Module) -> dict[str, ast.AST]:
+        return {fn.name: fn for fn in _function_index(tree)}
+
+    def _check_dp402(self, tree: ast.Module) -> None:
+        local_fns = self._local_callables(tree)
+        # innermost-loop attribution: collect every while, then drop
+        # blocking calls owned by a nested while.
+        whiles = [n for n in ast.walk(tree) if isinstance(n, ast.While)]
+        inner_whiles: dict[int, list[ast.While]] = {}
+        for w in whiles:
+            inner_whiles[id(w)] = [
+                n for n in _walk_skipping_defs(w.body + w.orelse)
+                if isinstance(n, ast.While)
+            ]
+        for w in whiles:
+            nested = set()
+            for iw in inner_whiles[id(w)]:
+                for n in _walk_skipping_defs(iw.body + iw.orelse):
+                    nested.add(id(n))
+            blocking: list[tuple[int, str]] = []
+            called_names: set[str] = set()
+            for node in _walk_skipping_defs(w.body + w.orelse):
+                if id(node) in nested or not isinstance(node, ast.Call):
+                    continue
+                what = self._blocking_call(node)
+                if what is not None:
+                    blocking.append((node.lineno, what))
+                last = _last(_dotted(node.func))
+                if last is not None:
+                    called_names.add(last)
+            if not blocking:
+                continue
+            fn = _enclosing_function(tree, w)
+            tainted = self._mono_derived_names(fn)
+            if self._has_deadline_compare([w.test], tainted) or \
+                    self._has_deadline_compare(w.body + w.orelse, tainted):
+                continue
+            # One level of interprocedural proof: a same-module function
+            # the body calls every turn may own the deadline
+            # (quiesce_blocking -> quiesce_step).
+            proven = False
+            for name in called_names:
+                callee = local_fns.get(name)
+                if callee is None:
+                    continue
+                callee_tainted = self._mono_derived_names(callee)
+                if self._has_deadline_compare(callee.body, callee_tainted):
+                    proven = True
+                    break
+            if proven:
+                continue
+            line, what = min(blocking)
+            self._emit(
+                "DP402", line,
+                f"`while` loop at line {w.lineno} blocks on `{what}` with "
+                f"no `time.monotonic()` deadline dominating the loop — a "
+                f"dead peer/producer wedges this process forever; derive a "
+                f"deadline from the config timeout and compare it in the "
+                f"loop (or audit a run-forever service loop with "
+                f"`# dplint: allow(DP402)`)",
+                extra_lines=(w.lineno, w.lineno - 1),
+            )
+
+    # -- DP403: wall-clock deadline arithmetic -------------------------
+
+    def _check_dp403(self, tree: ast.Module) -> None:
+        # A wall-clock read is flagged only when it feeds arithmetic
+        # DIRECTLY: walking UP from the call, the nearest enclosing
+        # Compare/BinOp(+/-) must come before any other call or statement
+        # boundary. `deadline = time.time() + t` fires;
+        # `json.dumps({"ts": time.time()}) + "\n"` and
+        # `f(now=time.time())` are data stamps and stay clean.
+        parents: dict[int, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and self._clocks.is_wall(node)):
+                continue
+            cur = parents.get(id(node))
+            while cur is not None:
+                if isinstance(cur, ast.Compare) or (
+                    isinstance(cur, ast.BinOp)
+                    and isinstance(cur.op, (ast.Add, ast.Sub))
+                ):
+                    name = _dotted(node.func) or "time.time"
+                    self._emit(
+                        "DP403", node.lineno,
+                        f"wall-clock `{name}()` used in deadline/duration "
+                        f"arithmetic — an NTP step silently stretches or "
+                        f"collapses the budget; use `time.monotonic()` "
+                        f"for deadlines and durations (wall-clock belongs "
+                        f"only in recorded `ts` data stamps)",
+                        extra_lines=(node.lineno - 1,),
+                    )
+                    break
+                if isinstance(cur, (ast.Call, ast.stmt)):
+                    break  # argument/stored data, not deadline arithmetic
+                cur = parents.get(id(cur))
+
+    # -- DP404: flightrec event-kind drift -----------------------------
+
+    @staticmethod
+    def _registered_kinds() -> dict[str, str]:
+        from tpu_dp.obs.flightrec import KINDS
+
+        return KINDS
+
+    def _collect_and_check_kinds(self, tree: ast.Module) -> None:
+        kinds = self._registered_kinds()
+        renders = self._rendered_containers(tree)
+        defines_renderer = bool(renders)
+
+        def saw_emit(kind: str, line: int) -> None:
+            self.emitted_kinds.setdefault(kind, line)
+            if kind not in kinds:
+                self._emit(
+                    "DP404", line,
+                    f"event kind {kind!r} is not declared in the "
+                    f"single-source registry `tpu_dp.obs.flightrec.KINDS` "
+                    f"— register it (with a one-line meaning) so the "
+                    f"timeline renderer and the emitters cannot drift",
+                )
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                func = node.func
+                name = (func.attr if isinstance(func, ast.Attribute)
+                        else func.id if isinstance(func, ast.Name) else None)
+                is_rec = name in ("record", "_record")
+                is_add = name == "add" and defines_renderer
+                if (is_rec or is_add) and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    saw_emit(node.args[0].value, node.lineno)
+            elif isinstance(node, ast.Dict):
+                for key, val in zip(node.keys, node.values):
+                    if isinstance(key, ast.Constant) and \
+                            key.value == "event" and \
+                            isinstance(val, ast.Constant) and \
+                            isinstance(val.value, str):
+                        saw_emit(val.value, val.lineno)
+
+        # the quarantine-log -> timeline kind mapping emits its VALUES
+        for name, container in renders.items():
+            if name != "_QUARANTINE_KINDS":
+                continue
+            for kind, line in self._literal_elements(container):
+                saw_emit(kind, line)
+
+        # rendered sets: registration checked here; emitted-somewhere is
+        # a whole-tree property resolved in lint_paths().
+        for name, container in renders.items():
+            if name == "_QUARANTINE_KINDS":
+                continue
+            for kind, line in self._literal_elements(container):
+                self.rendered_kinds.append((kind, name, line))
+                if kind not in kinds:
+                    self._emit(
+                        "DP404", line,
+                        f"{name} renders event kind {kind!r}, which is not "
+                        f"declared in `tpu_dp.obs.flightrec.KINDS` — the "
+                        f"renderer and the registry have drifted",
+                    )
+
+    @staticmethod
+    def _rendered_containers(tree: ast.Module) -> dict[str, ast.AST]:
+        """Top-level MARKER_KINDS/_REPLICATED_KINDS/_QUARANTINE_KINDS
+        assignments (the obsctl rendering surface, or a fixture's twin)."""
+        wanted = {"MARKER_KINDS", "_REPLICATED_KINDS", "_QUARANTINE_KINDS"}
+        out: dict[str, ast.AST] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id in wanted:
+                        out[t.id] = node.value
+        return out
+
+    @staticmethod
+    def _literal_elements(container: ast.AST) -> list[tuple[str, int]]:
+        """Literal string members of a tuple/set/list/frozenset(...) or the
+        literal VALUES of a dict (`_QUARANTINE_KINDS` maps log kind ->
+        timeline kind; both sides reach the timeline, the values via the
+        mapping, the keys via their own record() sites)."""
+        if isinstance(container, ast.Call) and container.args:
+            container = container.args[0]  # frozenset({...})
+        out: list[tuple[str, int]] = []
+        if isinstance(container, ast.Dict):
+            elts: list[ast.AST] = list(container.values)
+        elif isinstance(container, (ast.Tuple, ast.List, ast.Set)):
+            elts = list(container.elts)
+        else:
+            return out
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append((e.value, e.lineno))
+        return out
+
+    # -- DP405: counter/gauge name drift -------------------------------
+
+    @staticmethod
+    def _registered_metrics() -> tuple[dict[str, str], dict[str, str]]:
+        from tpu_dp.obs.counters import METRIC_FAMILIES, METRICS
+
+        return METRICS, METRIC_FAMILIES
+
+    def _check_dp405(self, tree: ast.Module) -> None:
+        metrics, families = self._registered_metrics()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute) or \
+                    func.attr not in ("inc", "gauge"):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                name, dynamic = arg.value, False
+            elif isinstance(arg, ast.JoinedStr):
+                prefix = ""
+                for part in arg.values:
+                    if isinstance(part, ast.Constant) and \
+                            isinstance(part.value, str):
+                        prefix += part.value
+                    else:
+                        break
+                name, dynamic = prefix, True
+            else:
+                continue  # computed name: not lintable, not linted
+            if not dynamic and name in metrics:
+                continue
+            if any(name.startswith(p) for p in families) or \
+                    (dynamic and any(p.startswith(name) for p in families)):
+                continue
+            kind = ("f-string metric prefix" if dynamic
+                    else "metric name")
+            self._emit(
+                "DP405", node.lineno,
+                f"{kind} {name!r} at a `.{func.attr}(...)` site is not "
+                f"declared in `tpu_dp.obs.counters.METRICS` (exact) or "
+                f"`METRIC_FAMILIES` (dynamic-suffix prefix) — an obsctl "
+                f"diff/watch signal naming it would silently never fire; "
+                f"register the metric",
+            )
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+
+
+def lint_source(path: str, source: str) -> list[Finding]:
+    """Per-file rules only (DP404's rendered-but-never-emitted direction
+    needs the whole analyzed set — use `lint_paths`)."""
+    return _HostLinter(path, source).run()
+
+
+def lint_file(path: str) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(path, f.read())
+
+
+def lint_paths(paths: Iterable[str]) -> list[Finding]:
+    """The full Level-4 pass: per-file rules plus the cross-file DP404
+    check that every *rendered* kind is emitted somewhere in the
+    analyzed tree (emit collection spans every given file, so linting
+    the whole package proves obsctl's markers against the real
+    emitters in ``train/`` and ``utils/`` too)."""
+    linters: list[_HostLinter] = []
+    findings: list[Finding] = []
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        linter = _HostLinter(path, source)
+        findings.extend(linter.run())
+        linters.append(linter)
+
+    kinds = _HostLinter._registered_kinds()
+    emitted: set[str] = set()
+    for linter in linters:
+        emitted |= set(linter.emitted_kinds)
+    for linter in linters:
+        for kind, container, line in linter.rendered_kinds:
+            if kind in kinds and kind not in emitted:
+                f = Finding(
+                    "DP404", linter.path, line,
+                    f"{container} renders event kind {kind!r}, but no "
+                    f"analyzed emit site publishes it — the timeline "
+                    f"renderer is waiting for forensics nobody records",
+                    symbol=scope_at(linter._scopes, line),
+                )
+                if not pragmas.is_allowed(linter.allowed, "DP404", (line,)):
+                    findings.append(f)
+    return findings

@@ -177,6 +177,37 @@ RULES: dict[str, tuple[str, str]] = {
         "every call — the compile-cache key never hits and step time "
         "cliffs silently",
     ),
+    "DP401": (
+        "protocol-seam filesystem IO outside the retry/fault-shim route",
+        "a ledger/checkpoint write not handed to retry_call and not "
+        "consulting faultinject.storage_shim is a seam chaos trials "
+        "cannot fault and a transient EIO turns into a lost publish — "
+        "the PR 14 fault-that-never-fires bug class",
+    ),
+    "DP402": (
+        "unbounded blocking poll in host-protocol code",
+        "a while loop that sleeps/waits with no time.monotonic() "
+        "deadline dominating it wedges the process forever when the "
+        "peer or producer it polls for is dead",
+    ),
+    "DP403": (
+        "wall-clock time in deadline/duration arithmetic",
+        "time.time() in a comparison or +/- expression lets an NTP step "
+        "silently stretch or collapse a multi-hour run's quiesce and "
+        "retry budgets; deadlines must use time.monotonic()",
+    ),
+    "DP404": (
+        "flightrec event-kind drift",
+        "an emitted kind missing from obs.flightrec.KINDS, or a kind "
+        "the obsctl timeline renders that nothing emits, means the "
+        "forensic record and its renderer have silently diverged",
+    ),
+    "DP405": (
+        "counter/gauge name drift",
+        "an inc/gauge site naming a metric absent from "
+        "obs.counters.METRICS/METRIC_FAMILIES lets an obsctl diff or "
+        "watch signal reference a counter nothing publishes",
+    ),
 }
 
 

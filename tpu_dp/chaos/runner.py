@@ -254,18 +254,21 @@ def run_trial(schedule: TrialSchedule, workdir: Path,
     ckpt = workdir / "ck"
     clauses = list(schedule.clauses)
     incarnations: list[dict] = []
-    t0 = time.time()
+    # Monotonic on purpose (DP403): the trial budget must survive NTP
+    # steps under the supervisor — wall-clock here once stretched or
+    # collapsed `timeout_s` with the host's clock discipline.
+    t0 = time.monotonic()
     resume = False
     deadline = t0 + timeout_s
     while True:
         spec = ";".join(c.to_spec() for c in clauses)
         argv = _trial_argv(ckpt, spec, schedule.guard_action, resume,
                            extra_argv)
-        budget = deadline - time.time()
+        budget = deadline - time.monotonic()
         if budget <= 0:
             return TrialResult(schedule, incarnations, ckpt,
-                               time.time() - t0, timed_out=True)
-        t1 = time.time()
+                               time.monotonic() - t0, timed_out=True)
+        t1 = time.monotonic()
         try:
             proc = subprocess.run(
                 argv, cwd=_repo_root(), env=_trial_env(),
@@ -274,16 +277,16 @@ def run_trial(schedule: TrialSchedule, workdir: Path,
         except subprocess.TimeoutExpired as e:
             incarnations.append({
                 "exit": None, "spec": spec,
-                "wall_s": round(time.time() - t1, 1),
+                "wall_s": round(time.monotonic() - t1, 1),
                 "stdout": (e.stdout or b"")[-4000:].decode(
                     "utf-8", "replace")
                 if isinstance(e.stdout, bytes) else (e.stdout or "")[-4000:],
             })
             return TrialResult(schedule, incarnations, ckpt,
-                               time.time() - t0, timed_out=True)
+                               time.monotonic() - t0, timed_out=True)
         incarnations.append({
             "exit": proc.returncode, "spec": spec,
-            "wall_s": round(time.time() - t1, 1),
+            "wall_s": round(time.monotonic() - t1, 1),
             "stdout": proc.stdout[-8000:],
             "stderr": proc.stderr[-4000:],
         })
@@ -308,7 +311,8 @@ def run_trial(schedule: TrialSchedule, workdir: Path,
             clauses = _relaunch_remainder(clauses)
             resume = True
             continue
-        return TrialResult(schedule, incarnations, ckpt, time.time() - t0)
+        return TrialResult(schedule, incarnations, ckpt,
+                           time.monotonic() - t0)
 
 
 # ---------------------------------------------------------------------------

@@ -593,10 +593,23 @@ class CheckpointManager:
 
         def _write():
             _atomic_write_state(step_dir, host_state, meta)
+
             # Publish: latest points at a fully-written checkpoint only.
-            ptr_tmp = self.ckpt_dir / "latest.tmp"
-            ptr_tmp.write_text(step_dir.name)
-            os.replace(ptr_tmp, self.ckpt_dir / "latest")
+            # The pointer flip is the commit point of the whole save, so
+            # it rides the same route as the state write (DP401): under
+            # the IO retry budget, with the storage-fault seam consulted
+            # inside the retried block — before this, a transient EIO
+            # here orphaned a fully-written checkpoint, and chaos trials
+            # could not even inject that failure.
+            def _publish():
+                shim = _chaos_shim()
+                if shim is not None:
+                    shim.on_write(self.ckpt_dir / "latest")
+                ptr_tmp = self.ckpt_dir / "latest.tmp"
+                ptr_tmp.write_text(step_dir.name)
+                os.replace(ptr_tmp, self.ckpt_dir / "latest")
+
+            _io_retry(_publish, describe=f"publish latest={step_dir.name}")
             # Retention: prune oldest beyond keep (never the one just written).
             if self.keep > 0:
                 import shutil
@@ -739,7 +752,18 @@ def save_params(path: str | os.PathLike, params) -> Path | None:
         return None
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    path.write_bytes(serialization.to_bytes(_to_host(params)))
+    payload = serialization.to_bytes(_to_host(params))
+
+    # The export is the artifact serving promotes from: routed like the
+    # checkpoint seams (DP401) so a transient EIO retries instead of
+    # losing the final weights, and chaos trials can fault it.
+    def _write():
+        shim = _chaos_shim()
+        if shim is not None:
+            shim.on_write(path)
+        path.write_bytes(payload)
+
+    _io_retry(_write, describe=f"export params {path.name}")
     return path
 
 

@@ -225,7 +225,21 @@ class DataPipeline:
         t.start()
         try:
             while True:
-                item = q.get()
+                # Bounded get (DP402): a producer thread that dies without
+                # delivering its sentinel (killed interpreter shutdown,
+                # `BaseException` path losing the race to `_put`) used to
+                # wedge the consumer on a bare q.get() forever. The
+                # timeout exists only to run the liveness check — the
+                # sentinel/exception protocol is still the real handoff.
+                try:
+                    item = q.get(timeout=1.0)
+                except queue.Empty:
+                    if not t.is_alive():
+                        raise RuntimeError(
+                            "prefetch producer thread died without "
+                            "delivering its end-of-epoch sentinel"
+                        ) from None
+                    continue
                 if item is _END:
                     break
                 if isinstance(item, BaseException):

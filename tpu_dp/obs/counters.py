@@ -85,6 +85,98 @@ class Counters:
         self._gauges.clear()
 
 
+#: Single-source metric-name registry: every literal name at an
+#: ``.inc(...)``/``.gauge(...)`` site must appear here (exact) or match a
+#: `METRIC_FAMILIES` prefix (dynamic-suffix families like per-replica
+#: health). dplint DP405 (`tpu_dp.analysis.hostproto`) enforces it, so an
+#: obsctl diff/watch signal can never silently name a counter nothing
+#: publishes. Registration stays a plain dict (import-light, no enum) —
+#: emit sites keep using bare strings; this table is the audit surface.
+METRICS: dict[str, str] = {
+    # retry machinery (resilience/retry.py)
+    "retry.attempts": "IO attempts made under retry_call",
+    "retry.retries": "attempts beyond the first (transient failures)",
+    "retry.exhausted": "retry budgets exhausted (error surfaced)",
+    # checkpoint protocol (checkpoint.py, resilience/preempt.py)
+    "ckpt.write_errors": "checkpoint writes failed after retries",
+    "ckpt.corrupt_candidates": "resume candidates failing verification",
+    "ckpt.verified_loads": "checkpoint loads with checksum verified",
+    "ckpt.unverified_loads": "loads of pre-checksum-era checkpoints",
+    "ckpt.checksum_failures": "per-file checksum mismatches seen",
+    "ckpt.skipped_candidates": "quarantined/partial steps skipped",
+    # snapshot engine (resilience/snapshot.py)
+    "snapshot.writes": "rollback snapshots taken",
+    "snapshot.write_s": "seconds spent writing snapshots",
+    "snapshot.write_errors": "async snapshot spills failed",
+    "snapshot.wait_s": "seconds steps waited on snapshot drains",
+    # elastic membership (resilience/elastic.py, trainer)
+    "elastic.departures": "peer departures detected",
+    "elastic.regroups": "membership regroups committed",
+    "elastic.regroup_s": "seconds spent inside regroups",
+    "elastic.lost_ranks": "ranks lost across regroups",
+    "elastic.joined_ranks": "ranks admitted by grow paths",
+    "elastic.joins": "join requests this rank has made",
+    "elastic.membership_epoch": "current membership epoch (gauge)",
+    # divergence guard (resilience/guard.py, train/hooks.py)
+    "guard.rollbacks": "guard-initiated rollbacks",
+    "guard.quarantined": "ranks quarantined",
+    "guard.halts": "guard halts (budget exhausted)",
+    "guard.sdc_audits": "SDC audit windows executed",
+    "guard.sdc_mismatches": "SDC audits that mismatched",
+    # preemption (resilience/preempt.py)
+    "preempt.signals": "preemption signals received",
+    # serving fleet (serve/)
+    "serve.shed": "requests shed at admission",
+    "serve.accepted": "requests admitted to the queue",
+    "serve.batches": "batches dispatched",
+    "serve.completed": "requests completed",
+    "serve.deadline_missed": "requests completed past their SLO deadline",
+    "serve.batch_occupancy": "last dispatched batch occupancy (gauge)",
+    "serve.device_util": "device-utilization proxy (gauge)",
+    "serve.replicas_live": "replicas currently live (gauge)",
+    "serve.replica_quarantine_events": "replica quarantine transitions",
+    "serve.failover.retried": "requests retried on another replica",
+    "serve.model_version": "model version a replica serves (gauge)",
+    "serve.membership_epoch": "serve-fleet membership epoch (gauge)",
+    # observability derived rates (obs/, train/trainer.py)
+    "throughput.images_per_sec": "global training throughput (gauge)",
+    "obs.comm_ms": "per-window collective time (gauge, ms)",
+    "obs.exposed_comm_ms": "per-window exposed (unoverlapped) comm ms",
+    "obs.overlap_frac": "fraction of comm overlapped with compute",
+    "obs.flops_per_step_per_chip": "model FLOPs per step per chip",
+    "obs.step_time_ms": "smoothed step time (gauge, ms)",
+    "obs.goodput": "examples/s across the slice (gauge)",
+    "obs.mfu": "model FLOPs utilization (gauge)",
+    # quantized-collective codec (parallel/compress.py)
+    "quant.overflow": "int8 blocks clipped at the absmax scale",
+    "quant.clip_blocks": "blocks whose scale clipped the payload",
+    # analyzer / compile cache (analysis/recompile.py)
+    "recompile.retraces": "jit retraces observed past warmup",
+    # chaos storage-fault injection (chaos/storage.py)
+    "chaos.storage_armed": "storage-fault seams armed",
+    "chaos.storage_faults": "injected storage faults fired",
+    "chaos.storage_slow_reads": "injected slow-read stalls served",
+    # device memory (update_device_memory_gauges)
+    "device.mem_in_use_bytes": "max HBM in use across local devices",
+}
+
+#: Dynamic-suffix families: a literal (or f-string prefix) matching one of
+#: these prefixes is registered as a family member — the suffix is data
+#: (rank, replica sid, SLO class, bucket index, device ordinal).
+METRIC_FAMILIES: dict[str, str] = {
+    "serve.shed.": "sheds by reason / SLO class",
+    "serve.accepted.c": "admissions by SLO class",
+    "serve.completed.c": "completions by SLO class",
+    "serve.deadline_missed.c": "SLO misses by class",
+    "serve.replica_health.": "per-replica health gauge by sid",
+    "serve.replica_batches.": "batches served by replica sid",
+    "serve.device_util.b": "device-utilization proxy by bucket",
+    "guard.": "guard trigger counts by verdict kind",
+    "device.mem_in_use_bytes.": "HBM in use by local device ordinal",
+    "device.mem_limit_bytes.": "HBM limit by local device ordinal",
+}
+
+
 #: The process-wide registry every subsystem publishes into.
 counters = Counters()
 

@@ -77,6 +77,81 @@ DUMP_GLOB = "flightrec_r*.json"
 #: the hang-dump sentinel rank 0's HealthMonitor drops into the obs dir.
 DUMP_REQUEST = "dump_request.json"
 
+#: Single-source event-kind registry: every kind any subsystem emits
+#: (``flightrec.record``, a metrics ``{"event": ...}`` record, or an
+#: obsctl timeline synthesis site) and every kind ``obsctl timeline``
+#: renders MUST be declared here, with a one-line meaning. dplint DP404
+#: (`tpu_dp.analysis.hostproto`) enforces both directions — an emit of an
+#: unregistered kind and a rendered kind nothing emits are both lint
+#: failures — so the renderer and the emitters cannot drift apart the way
+#: the pre-registry ``dump_request`` marker did (rendered, never
+#: recorded). Registration is intentionally a dict, not an enum: kinds
+#: stay plain strings at emit sites (signal-handler-safe, no imports) and
+#: this table is the audit surface.
+KINDS: dict[str, str] = {
+    # -- step/epoch lifecycle (train/hooks.py, trainer, obsctl) ---------
+    "epoch_start": "an epoch began on this rank",
+    "step": "periodic step heartbeat with loss/throughput fields",
+    "epoch_complete": "obsctl-synthesized epoch boundary from metrics",
+    "eval": "obsctl-synthesized eval record from metrics.jsonl",
+    "exit": "Trainer.fit exit path (clean or exceptional), with reason",
+    # -- checkpoint / snapshot protocol ---------------------------------
+    "snapshot": "in-memory rollback snapshot taken",
+    "snapshot_write_error": "async snapshot spill failed (kept in RAM)",
+    "ckpt_write_error": "checkpoint write failed after retries",
+    "ckpt_corrupt": "checkpoint integrity verification failed on load",
+    "ckpt_corrupt_fallback": "load fell back to an older verified step",
+    "ckpt_skipped_candidate": "resume skipped a quarantined/partial step",
+    # -- divergence guard / SDC (resilience/guard.py, hooks) ------------
+    "guard_trigger": "divergence guard tripped (spike/SDC verdict)",
+    "guard_rollback": "guard rolled state back to a snapshot",
+    "guard_halt": "guard halted the run (rollback budget exhausted)",
+    "guard_sdc": "SDC audit verdict recorded",
+    "guard_spike": "loss-spike verdict recorded",
+    "guard_evict": "guard evicted a suspect rank",
+    "guard_quarantine": "rank quarantined by the guard protocol",
+    "guard_tombstone": "rank tombstoned (permanent quarantine)",
+    # -- quarantine log kinds (resilience/guard.py QuarantineLog) -------
+    "spike": "quarantine-log loss-spike entry",
+    "sdc": "quarantine-log SDC-mismatch entry",
+    "quarantine": "quarantine-log quarantine entry",
+    "tombstone": "quarantine-log tombstone entry",
+    # -- elastic membership (resilience/elastic.py, trainer) ------------
+    "membership_epoch": "membership epoch committed to the ledger",
+    "membership_formed": "obsctl-synthesized membership view formed",
+    "elastic_trigger": "elastic regroup triggered (departure/grow)",
+    "elastic_departure": "peer departure detected",
+    "elastic_suspect": "peer suspected dead (missed heartbeats)",
+    "elastic_regroup": "regroup committed; ranks/mesh rebuilt",
+    "elastic_grow": "grow path admitted waiting joiners",
+    "elastic_join": "this rank joined a running job",
+    "elastic_join_request": "join request observed in the ledger",
+    "join_refused": "join request refused (quota/epoch mismatch)",
+    "rank_joined": "obsctl-synthesized joiner admission record",
+    "eviction": "rank evicted from the membership ledger",
+    # -- preemption ------------------------------------------------------
+    "preempt_signal": "SIGTERM/preemption notice received",
+    "preempt_exit": "run exited at a preemption boundary",
+    # -- serving fleet (serve/) -----------------------------------------
+    "model_swap": "replica swapped to a new model version",
+    "serve_dispatch": "batch dispatched to the device",
+    "replica_failed": "replica marked failed by the router",
+    "replica_drain_begin": "router began draining a replica",
+    "replica_drain": "replica drain completed",
+    "replica_rejoin": "failed replica rejoined the fleet",
+    "replica_quarantined": "flapping replica quarantined by health gate",
+    "replica_restored": "quarantined replica restored to rotation",
+    # -- chaos / storage faults (chaos/storage.py) ----------------------
+    "storage_fault_armed": "storage-fault schedule armed on a seam",
+    "storage_fault": "injected storage fault fired",
+    # -- observability machinery ----------------------------------------
+    "comm_profile": "communication profile window summarized",
+    "profile_start": "profiler capture started",
+    "profile_stop": "profiler capture stopped",
+    "dump_request": "hang-dump sentinel honored; ring dumped mid-run",
+    "alert": "obsctl-synthesized alert from signal thresholds",
+}
+
 
 def dump_path_for(dump_dir: str | os.PathLike, rank: int,
                   tag: str = "") -> Path:
@@ -253,6 +328,10 @@ class FlightRecorder:
             why = json.loads(req.read_text()).get("reason", "requested")
         except (OSError, ValueError):
             why = "requested"
+        # The honored request is itself an event: before DP404 this kind
+        # was rendered by the timeline but never emitted, so a hang
+        # postmortem could not see WHICH window each survivor dumped in.
+        self.record("dump_request", reason=str(why))
         return self.dump(reason=f"dump_request: {why}")
 
     def reset(self) -> None:

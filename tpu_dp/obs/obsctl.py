@@ -1222,7 +1222,11 @@ def cmd_watch(args) -> int:
             eng.observe_record(rec)
         eng.observe_state(end_signals(art))
     else:
-        deadline = _time.time() + max(0.0, args.for_s)
+        # The poll budget is monotonic (DP403/DP402): an NTP step on the
+        # pager host must not stretch or cut `--for-s`. Wall-clock stays
+        # only where it is DATA — the `now`/`ts` stamps compared against
+        # artifact mtimes and recorded in alerts.
+        deadline = _time.monotonic() + max(0.0, args.for_s)
         tail = _MetricsTail(art.metrics_path)
         while True:
             # Raw append-order tail (no generation sweep): live watching
@@ -1232,7 +1236,7 @@ def cmd_watch(args) -> int:
                 eng.observe_record(rec)
             eng.observe_state(end_signals(art, now=_time.time()),
                               ts=_time.time())
-            if _time.time() >= deadline:
+            if _time.monotonic() >= deadline:
                 break
             _time.sleep(max(0.1, args.interval))
 

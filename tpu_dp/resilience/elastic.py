@@ -807,7 +807,11 @@ class MembershipLedger:
     # -- post-quiesce barrier ------------------------------------------
 
     def ack_quiesced(self, epoch: int) -> None:
-        (self.dir / f"q_e{int(epoch):04d}_r{self.sid:05d}.done").touch()
+        # Routed through the ledger IO budget like every other barrier
+        # file (DP401): a transient EIO on the ack would otherwise read
+        # as a straggler that never quiesced.
+        path = self.dir / f"q_e{int(epoch):04d}_r{self.sid:05d}.done"
+        _ledger_io(path.touch, f"touch {path.name}")
 
     def await_quiesced(self, epoch: int, sids: Sequence[int],
                        timeout_s: float, poll_s: float = 0.05) -> list[int]:

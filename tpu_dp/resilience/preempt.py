@@ -55,6 +55,11 @@ def quarantine_save_dir(step_dir: Path, reason: str) -> None:
 
     path = Path(step_dir) / QUARANTINED_MARKER
     if not path.exists():
+        # Advisory marker, not protocol state: a lost write costs one
+        # extra candidate-verification on resume (the integrity manifest
+        # still rejects the corrupt save), so retrying or fault-injecting
+        # it would add a seam with nothing to protect.
+        # dplint: allow(DP401) advisory metadata outside the IO protocol
         path.write_text(json.dumps(
             {"reason": reason, "ts": time.time()}) + "\n")
 

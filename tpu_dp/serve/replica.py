@@ -502,6 +502,11 @@ class ServeReplica:
     def _loop(self) -> None:
         batch = None
         try:
+            # Run-forever service loop by design: lifetime is bounded by
+            # the stop/drain flags checked first thing every turn (and
+            # every sleep is a short backpressure nap), not by a
+            # deadline — a serving replica has no natural timeout.
+            # dplint: allow(DP402) flag-bounded service loop, no deadline
             while True:
                 if self._stop.is_set():  # abandon mode: stop(drain=False)
                     self.status = "stopped"
