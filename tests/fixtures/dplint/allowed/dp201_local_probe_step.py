@@ -1,0 +1,29 @@
+"""Pragma'd twin of dp201_unreduced — DP201 audited, must NOT fire.
+
+Identical bug shape (no data-axis reduction before the update), but this
+one is a deliberate replica-local probe: each replica fits a throwaway
+head on its own shard to estimate local gradient noise, and the results
+are never folded back into the replicated params. The pragma on the
+step's `def` line (where the jaxpr pass attributes its finding) is the
+audit record; the clean-twin test drives the full CLI and requires
+exit 0.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def DPLINT_LOCAL_STEP():
+    def loss_fn(params, x):
+        return jnp.sum((x @ params) ** 2)
+
+    def step(state, batch):  # dplint: allow(DP201) replica-local probe
+        grads = jax.grad(loss_fn)(state["params"], batch["x"])
+        new_params = state["params"] - 0.1 * grads
+        return {"params": new_params}, {"grad_norm": jnp.sum(grads**2)}
+
+    example = (
+        {"params": jnp.ones((4, 2), jnp.float32)},
+        {"x": jnp.ones((8, 4), jnp.float32)},
+    )
+    return step, example

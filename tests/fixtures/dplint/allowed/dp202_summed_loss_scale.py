@@ -1,0 +1,37 @@
+"""Pragma'd twin of dp202_double_reduced — DP202 audited, must NOT fire.
+
+Identical bug shape (one pmean per microbatch plus one per update), but
+here the double averaging is deliberate: the outer pmean folds in a
+cross-replica loss-scale consensus and the inner one is compensated by
+the ACCUM_STEPS rescale. The pragma on the step's `def` line (where the
+jaxpr pass attributes its finding) is the audit record.
+"""
+
+import jax
+import jax.numpy as jnp
+
+ACCUM_STEPS = 2
+
+
+def DPLINT_LOCAL_STEP():
+    def loss_fn(params, x):
+        return jnp.sum((x @ params) ** 2)
+
+    def step(state, batch):  # dplint: allow(DP202) compensated rescale
+        def micro(grads_acc, x_mb):
+            g = jax.grad(loss_fn)(state["params"], x_mb)
+            g = jax.lax.pmean(g, "data")  # dplint: allow(DP103)
+            return grads_acc + g, None
+
+        zeros = jnp.zeros_like(state["params"])
+        grads, _ = jax.lax.scan(micro, zeros, batch["x"])
+        grads = grads / ACCUM_STEPS
+        grads = jax.lax.pmean(grads, "data")  # dplint: allow(DP103)
+        new_params = state["params"] - 0.1 * grads
+        return {"params": new_params}, {}
+
+    example = (
+        {"params": jnp.ones((4, 2), jnp.float32)},
+        {"x": jnp.ones((ACCUM_STEPS, 8, 4), jnp.float32)},
+    )
+    return step, example

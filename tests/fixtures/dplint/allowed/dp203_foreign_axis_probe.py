@@ -1,0 +1,26 @@
+"""Pragma'd twin of dp203_bad_axis — DP203 audited, must NOT fire.
+
+Identical bug shape (a collective spelled over an axis the data-parallel
+mesh does not define), audited as a staging shim for a model-parallel
+mesh this binary does not build yet. The pragma on the step's `def` line
+(where the jaxpr pass attributes its finding) is the audit record.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def DPLINT_LOCAL_STEP():
+    def loss_fn(params, x):
+        return jnp.sum((x @ params) ** 2)
+
+    def step(state, batch):  # dplint: allow(DP203) staged MP axis
+        grads = jax.grad(loss_fn)(state["params"], batch["x"])
+        grads = jax.lax.pmean(grads, "model")  # dplint: allow(DP103)
+        return {"params": state["params"] - 0.1 * grads}, {}
+
+    example = (
+        {"params": jnp.ones((4, 2), jnp.float32)},
+        {"x": jnp.ones((8, 4), jnp.float32)},
+    )
+    return step, example

@@ -1,0 +1,28 @@
+"""Pragma'd twin of dp301_extra_allgather — DP301 audited, must NOT fire.
+
+Identical bug shape (sharded input, replicated output, so GSPMD
+materializes a cross-replica all-gather), audited as a deploy-time
+export program that runs exactly once — the gather is the point, not a
+per-step leak. The pragma on the program's `def` line (where the HLO
+pass attributes its finding) is the audit record.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_dp.parallel import dist
+
+
+def DPLINT_HLO_PROGRAM():
+    mesh = dist.data_mesh()
+
+    def step(x):  # dplint: allow(DP301) one-shot export gather
+        return x * 2.0
+
+    fn = jax.jit(
+        step,
+        in_shardings=(NamedSharding(mesh, P(dist.DATA_AXIS)),),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+    return {"fn": fn, "args": (jnp.zeros((16, 4), jnp.float32),)}

@@ -1,0 +1,18 @@
+"""Pragma'd twin of dp302_host_callback — DP302 audited, must NOT fire.
+
+Identical bug shape (`jax.debug.print` compiled into the step as a
+host-callback custom-call), audited as a debug build behind a flag that
+never ships. The pragma on the program's `def` line (where the HLO pass
+attributes its finding) is the audit record.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def DPLINT_HLO_PROGRAM():
+    def step(x):  # dplint: allow(DP302) debug build, never ships
+        jax.debug.print("loss={v}", v=x.sum())
+        return x + 1.0
+
+    return {"fn": step, "args": (jnp.zeros((8,), jnp.float32),)}

@@ -1,0 +1,28 @@
+"""Pragma'd twin of dp303_dropped_donation — DP303 audited, must NOT fire.
+
+Identical bug shape (dtype-changing output defeats the donation, XLA
+drops the aliasing with only a warning), audited as a one-shot bf16
+export where the double allocation is accepted. The pragma on the
+program's `def` line (where the HLO pass attributes its finding) is the
+audit record.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def DPLINT_HLO_PROGRAM():
+    def step(params):  # dplint: allow(DP303) one-shot bf16 export
+        return jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), params
+        )
+
+    params = {
+        "w": jnp.zeros((64, 64), jnp.float32),
+        "b": jnp.zeros((64,), jnp.float32),
+    }
+    return {
+        "fn": step,
+        "args": (params,),
+        "jit_kwargs": {"donate_argnums": (0,)},
+    }

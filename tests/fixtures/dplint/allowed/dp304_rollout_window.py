@@ -1,0 +1,22 @@
+"""Pragma'd twin of dp304_fingerprint_mismatch — DP304 audited, must NOT
+fire.
+
+Identical bug shape (the compiled collective schedule no longer digests
+to the pinned fingerprint), audited for a rollout window in which the
+old pin is kept until every rank has the new binary. The pragma on the
+program's `def` line (where the HLO pass attributes its finding) is the
+audit record.
+"""
+
+import jax.numpy as jnp
+
+
+def DPLINT_HLO_PROGRAM():
+    def step(x):  # dplint: allow(DP304) rollout window, repin after
+        return x * 2.0
+
+    return {
+        "fn": step,
+        "args": (jnp.zeros((8,), jnp.float32),),
+        "expect_fingerprint": "0" * 64,
+    }

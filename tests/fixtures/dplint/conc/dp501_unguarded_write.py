@@ -1,0 +1,46 @@
+"""Adversarial dplint fixture — DP501: shared attribute written without
+its guarding lock.
+
+`BrokenMeter.snapshot` reads `self.samples` under `self._lock`, so the
+reader believes the lock excludes the writer — but the monitor thread's
+`_loop` bumps the counter with no lock at all: the classic mixed-guard
+race. The audited twin publishes a single GIL-atomic float heartbeat on
+purpose and says so next to the pragma.
+"""
+
+import threading
+import time
+
+
+class BrokenMeter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.samples = 0
+        self._monitor = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self, stop):
+        while not stop.is_set():
+            self.samples = self.samples + 1  # EXPECT: DP501
+            time.sleep(0.01)
+
+    def snapshot(self):
+        with self._lock:
+            return {"samples": self.samples}
+
+
+class AuditedMeter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.last_beat = 0.0
+        self._monitor = threading.Thread(target=self._beat, daemon=True)
+
+    def _beat(self, stop):
+        while not stop.is_set():
+            # Deliberate benign publish: one GIL-atomic float store; the
+            # guarded reader needs A consistent value, not THE latest.
+            self.last_beat = time.monotonic()  # dplint: allow(DP501)
+            time.sleep(0.01)
+
+    def read(self):
+        with self._lock:
+            return self.last_beat

@@ -1,0 +1,65 @@
+"""Adversarial dplint fixture — DP502: lock-acquisition-order cycle.
+
+`broken_enqueue` nests books -> stats while `broken_report` nests
+stats -> books: two threads entering from opposite ends deadlock. The
+second cycle hides one call down — `broken_flush` holds the journal
+lock and calls a helper that takes the index lock, while
+`broken_compact` nests them the other way. The audited twin documents
+a deliberately reversed nesting on a pair of locks whose holders can
+never overlap (boot vs teardown).
+"""
+
+import threading
+
+books_lock = threading.Lock()
+stats_lock = threading.Lock()
+journal_lock = threading.Lock()
+index_lock = threading.Lock()
+boot_lock = threading.Lock()
+side_lock = threading.Lock()
+
+BOOKS = {}
+STATS = {}
+
+
+def broken_enqueue(key, n):
+    with books_lock:
+        with stats_lock:  # EXPECT: DP502
+            STATS[key] = STATS.get(key, 0) + n
+            BOOKS[key] = n
+
+
+def broken_report(key):
+    with stats_lock:
+        with books_lock:
+            return STATS.get(key), BOOKS.get(key)
+
+
+def _touch_index(key):
+    with index_lock:
+        BOOKS[key] = True
+
+
+def broken_flush(key):
+    with journal_lock:
+        _touch_index(key)  # EXPECT: DP502
+
+
+def broken_compact(key):
+    with index_lock:
+        with journal_lock:
+            BOOKS.pop(key, None)
+
+
+def audited_boot(key):
+    with boot_lock:
+        with side_lock:
+            STATS[key] = 0
+
+
+def audited_teardown(key):
+    # Boot and teardown are serialized by the process lifecycle: the
+    # reversed nesting can never run concurrently with `audited_boot`.
+    with side_lock:
+        with boot_lock:  # dplint: allow(DP502)
+            STATS.pop(key, None)

@@ -1,0 +1,48 @@
+"""Adversarial dplint fixture — DP503: rank-gated participation divergence.
+
+Three wedges: a rank-local quiesce read gating an allgather its peers
+never enter (the PR 14 chaos bug, statically), a mismatched handshake
+(the leader publishes an epoch record while the peers block on a quiesce
+ack nobody produces), and a rank-gated early return that skips the
+barrier every other rank stands in. The clean twins are the legal
+shapes: a publish/await rendezvous, an unconditional collective behind a
+loudly *raising* guard, and an audited one-sided joiner await.
+"""
+
+
+def broken_gate(dist, quiesced, rank):
+    if quiesced.get(rank):
+        return dist.allgather(quiesced)  # EXPECT: DP503
+
+
+def broken_handshake(ledger, sid, leader, payload):
+    if sid == leader:
+        ledger.publish_epoch(payload)
+    else:
+        ledger.await_quiesced(payload)  # EXPECT: DP503
+
+
+def broken_early_exit(dist, rank, shard):
+    if rank != 0:
+        return None
+    return dist.barrier(shard)  # EXPECT: DP503
+
+
+def clean_rendezvous(ledger, sid, leader, payload):
+    if sid == leader:
+        ledger.publish_epoch(payload)
+    else:
+        ledger.await_epoch(payload)
+
+
+def clean_loud_guard(dist, plan, sid, shard):
+    if sid not in plan:
+        raise RuntimeError(f"rank {sid} evicted from the plan")
+    return dist.barrier(shard)
+
+
+def audited_joiner_wait(ledger, sid, deadline_s):
+    if sid is None:
+        # Joiner side of the admission handshake: an incumbent peer
+        # branch does not exist in this process by construction.
+        return ledger.await_epoch(deadline_s)  # dplint: allow(DP503)

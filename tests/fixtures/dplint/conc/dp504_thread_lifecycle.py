@@ -1,0 +1,72 @@
+"""Adversarial dplint fixture — DP504: thread lifecycle / wait discipline.
+
+A non-daemon worker that is never joined (nor even stored) keeps the
+process alive past every drain path; a daemon poller with no stop flag
+can never be drained; a bare `Condition.wait` misses wakeups and wakes
+spuriously, both by spec. Twins: a joined worker, a flag-checked
+poller, a predicate-`while` wait, and an audited process-lifetime
+fire-and-forget.
+"""
+
+import threading
+import time
+
+
+def _drain_once(q):
+    q.put_nowait(None)
+
+
+def broken_spawn(q):
+    threading.Thread(target=_drain_once, args=(q,)).start()  # EXPECT: DP504
+
+
+def _poll_forever(q):
+    while True:
+        q.put_nowait(time.monotonic())
+        time.sleep(0.05)
+
+
+def broken_daemon(q):
+    threading.Thread(  # EXPECT: DP504
+        target=_poll_forever, args=(q,), daemon=True,
+    ).start()
+
+
+def broken_wait(cond, ready):
+    with cond:
+        if not ready():
+            cond.wait(1.0)  # EXPECT: DP504
+
+
+def clean_join(q):
+    t = threading.Thread(target=_drain_once, args=(q,))
+    t.start()
+    t.join()
+
+
+_STOP = threading.Event()
+
+
+def _poll_until_stopped(q):
+    while not _STOP.is_set():
+        q.put_nowait(time.monotonic())
+        time.sleep(0.05)
+
+
+def clean_daemon(q):
+    threading.Thread(
+        target=_poll_until_stopped, args=(q,), daemon=True,
+    ).start()
+
+
+def clean_predicate_wait(cond, ready):
+    with cond:
+        while not ready():
+            cond.wait(1.0)
+
+
+def audited_fire_and_forget(sock):
+    # Process-lifetime responder: it must outlive every caller and dies
+    # with the interpreter; there is deliberately nothing to join.
+    # dplint: allow(DP504) process-lifetime responder, nothing to join
+    threading.Thread(target=_drain_once, args=(sock,)).start()

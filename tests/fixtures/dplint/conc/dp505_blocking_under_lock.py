@@ -1,0 +1,52 @@
+"""Adversarial dplint fixture — DP505: lock held across a blocking call.
+
+Durable IO and `time.sleep` under a lock stall every peer contending
+for it; the third case hides the blocking call one level down in a
+helper. Twins: snapshot-then-write outside the critical section, and
+the audited donated-buffer bracket whose whole point is pinning the
+swap pair across the device sync.
+"""
+
+import json
+import threading
+import time
+
+state_lock = threading.Lock()
+ring_lock = threading.Lock()
+swap_lock = threading.Lock()
+
+STATE = {}
+
+
+def broken_publish(path, payload):
+    with state_lock:
+        STATE.update(payload)
+        path.write_text(json.dumps(STATE))  # EXPECT: DP505
+
+
+def broken_backoff(delay_s):
+    with ring_lock:
+        time.sleep(delay_s)  # EXPECT: DP505
+
+
+def _settle(result):
+    result.block_until_ready()
+
+
+def broken_swap(result):
+    with swap_lock:
+        _settle(result)  # EXPECT: DP505
+
+
+def clean_publish(path, payload):
+    with state_lock:
+        STATE.update(payload)
+        snapshot = json.dumps(STATE)
+    path.write_text(snapshot)
+
+
+def audited_swap(result):
+    with swap_lock:
+        # Donated-buffer bracket: the swap pair stays pinned until the
+        # device writes land; releasing early is the use-after-donate.
+        result.block_until_ready()  # dplint: allow(DP505)

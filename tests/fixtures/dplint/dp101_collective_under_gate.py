@@ -15,3 +15,10 @@ def broken_epoch_summary(metrics):
     if jax.process_index() == 0:
         total = collectives.psum(metrics["loss"])  # EXPECT: DP101
         print("epoch loss:", total)
+
+
+def audited_probe_summary(metrics):
+    # Single-host probe tool: world size is pinned to 1 here, so the
+    # gated psum cannot exclude a peer.
+    if jax.process_index() == 0:  # dplint: allow(DP101)
+        return collectives.psum(metrics["loss"])

@@ -21,3 +21,11 @@ def noisy_step(x):
 def divergent_init():
     key = jax.random.PRNGKey(int(time.time()))  # EXPECT: DP102
     return jax.random.normal(key, (4,)) + jnp.zeros((4,))
+
+
+@jax.jit
+def audited_salted_step(x):
+    # Deliberate per-process salt, folded back out before any collective
+    # sees the value.
+    salt = time.time()  # dplint: allow(DP102)
+    return x + (salt - salt)

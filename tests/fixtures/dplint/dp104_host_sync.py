@@ -15,3 +15,12 @@ def chatty_step(state, batch):
     host_loss = jax.device_get(loss)  # EXPECT: DP104
     loss.block_until_ready()  # EXPECT: DP104
     return state - 0.1 * host_loss
+
+
+@jax.jit
+def audited_probe_step(state, batch):
+    loss = jnp.mean((batch - state) ** 2)
+    # Debug-only probe step: the stall is the point (step-time floor
+    # measurement), never enabled in the hot loop.
+    loss.block_until_ready()  # dplint: allow(DP104)
+    return state - 0.1 * loss

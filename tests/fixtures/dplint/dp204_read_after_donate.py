@@ -18,3 +18,15 @@ def broken_loop(model, optimizer, mesh, schedule, state, batches):
         # BUG: `state` was donated above and never rebound.
         print("step", state.step)  # EXPECT: DP204
     return losses
+
+
+def audited_loop(model, optimizer, mesh, schedule, state, batches):
+    train_step = make_train_step(model, optimizer, mesh, schedule)
+    losses = []
+    for batch in batches:
+        new_state, metrics = train_step(state, batch)
+        losses.append(metrics["loss"])
+        # CPU-only harness: donation is a no-op on this backend and the
+        # stale handle is the cheapest progress print available.
+        print("step", state.step)  # dplint: allow(DP204)
+    return losses

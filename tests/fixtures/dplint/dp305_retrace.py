@@ -17,3 +17,12 @@ def hot_loop(xs):
         # BUG: a fresh jit wrapper (and empty compile cache) per iteration.
         total = total + jax.jit(lambda v: v * v)(x)  # EXPECT: DP305
     return total
+
+
+def audited_cold_loop(xs):
+    total = 0.0
+    for x in xs:
+        # One-shot startup calibration: the fresh wrapper per dtype probe
+        # is deliberate and the compile cost is paid exactly once.
+        total = total + jax.jit(lambda v: v * v)(x)  # dplint: allow(DP305)
+    return total

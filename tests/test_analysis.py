@@ -84,22 +84,67 @@ def test_all_rules_covered_by_fixtures():
     """Every documented rule has at least one adversarial fixture.
 
     Level-4 host-protocol fixtures live in the `host/` subdirectory
-    (driven by `tests/test_hostproto.py` through the `host` subcommand,
-    not the device-program CLI this file exercises) but count toward the
+    (driven by `tests/test_hostproto.py` through the `host` subcommand)
+    and Level-5 concurrency fixtures in `conc/` (driven by
+    `tests/test_concurrency.py` through the `conc` subcommand), not the
+    device-program CLI this file exercises — but all count toward the
     same one-fixture-per-rule contract.
     """
     covered = set()
-    host_dir = os.path.join(FIXTURES, "host")
-    paths = [os.path.join(FIXTURES, f) for f in FIXTURE_FILES] + [
-        os.path.join(host_dir, f) for f in sorted(os.listdir(host_dir))
-        if f.endswith(".py")
-    ]
+    paths = [os.path.join(FIXTURES, f) for f in FIXTURE_FILES]
+    for sub in ("host", "conc"):
+        sub_dir = os.path.join(FIXTURES, sub)
+        paths += [
+            os.path.join(sub_dir, f) for f in sorted(os.listdir(sub_dir))
+            if f.endswith(".py")
+        ]
     for path in paths:
         for rule, _ in _expected_findings(path):
             covered.add(rule)
     assert covered == set(RULES), (
         f"rules without a fixture: {set(RULES) - covered}"
     )
+
+
+def test_every_rule_has_a_pragma_twin():
+    """No rule ships untested in either direction: every DP1xx–DP5xx
+    rule in RULES has at least one firing fixture (asserted above) and
+    one pragma'd non-firing twin somewhere in the fixture tree — inline
+    beside the firing case for the AST levels, under `allowed/` for the
+    traced jaxpr/HLO levels (whose silence
+    `test_pragma_twin_lints_clean` enforces), under `host/` and `conc/`
+    for Levels 4 and 5."""
+    allow_re = re.compile(r"#\s*dplint:\s*allow\(\s*(DP\d{3})")
+    twinned: set[str] = set()
+    for root, _dirs, files in os.walk(FIXTURES):
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            text = open(os.path.join(root, f), encoding="utf-8").read()
+            twinned.update(m.group(1) for m in allow_re.finditer(text))
+    assert twinned >= set(RULES), (
+        f"rules without a pragma'd twin: {set(RULES) - twinned}"
+    )
+
+
+ALLOWED_DIR = os.path.join(FIXTURES, "allowed")
+ALLOWED_FILES = sorted(
+    f for f in os.listdir(ALLOWED_DIR) if f.endswith(".py")
+)
+
+
+@pytest.mark.parametrize("twin", ALLOWED_FILES)
+def test_pragma_twin_lints_clean(twin, capsys):
+    """The non-firing direction for the traced levels: the same bug
+    shape as the sibling firing fixture, audited with a pragma on the
+    hook program's `def` line (where the jaxpr/HLO passes attribute
+    their findings) — the full CLI must exit 0."""
+    path = os.path.join(ALLOWED_DIR, twin)
+    rc, payload = _run_cli(capsys, [path, "--fingerprint-out", "none"])
+    assert rc == 0, (
+        f"{twin}: expected exit 0, got {rc}: {payload['findings']}"
+    )
+    assert payload["findings"] == []
 
 
 # -- 2. the shipped tree is clean ----------------------------------------
@@ -129,6 +174,44 @@ def test_cli_launcher_runs_from_checkout():
     assert proc.returncode == 0
     for rule in RULES:
         assert rule in proc.stdout
+
+
+def test_changed_mode_lints_only_the_diff(tmp_path):
+    """`tools/dplint.py host --changed` resolves the git repo of its cwd,
+    diffs against the merge-base, and lints only what moved: a clean tree
+    exits 0 with a no-op note, and a freshly added violation exits 1."""
+    def git(*args):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=tmp_path, check=True, capture_output=True, text=True,
+        )
+
+    git("init", "-q")
+    (tmp_path / "README").write_text("scratch repo\n")
+    git("add", "README")
+    git("commit", "-qm", "seed")
+
+    launcher = os.path.join(REPO, "tools", "dplint.py")
+    proc = subprocess.run(
+        [sys.executable, launcher, "host", "--changed"],
+        cwd=tmp_path, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no python files differ" in proc.stdout
+
+    (tmp_path / "bad.py").write_text(
+        "from pathlib import Path\n"
+        "\n"
+        "def persist(rank, blob):\n"
+        "    Path('ck.bin').write_text(blob)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, launcher, "host", "--changed", "--json"],
+        cwd=tmp_path, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    rules = {f["rule"] for f in json.loads(proc.stdout)["findings"]}
+    assert "DP401" in rules, rules
 
 
 # -- 3. gradient-sync regression: exactly one reduction per leaf ---------

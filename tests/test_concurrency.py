@@ -1,0 +1,394 @@
+"""dplint Level 5 (`tpu_dp.analysis.concurrency`) — concurrency rules.
+
+Three layers of coverage, mirroring `tests/test_hostproto.py`:
+
+1. Adversarial fixtures (`tests/fixtures/dplint/conc/`): one known-bad
+   module per rule, DP501–DP505. Each marks its finding lines with
+   ``# EXPECT: <RULE>`` and carries a pragma'd twin that must NOT fire;
+   the test drives the real CLI (`python -m tpu_dp.analysis conc` via
+   `cli.main(["conc", ...])`) and asserts the exit code, rule, file, and
+   the EXACT finding set (a pragma'd twin firing is as much a regression
+   as a violation not firing).
+2. The shipped tree is clean: `python -m tpu_dp.analysis conc` exits 0
+   (every real violation this PR found was fixed or pragma-audited), and
+   the one real race fix (`ServeReplica.snapshot`'s mixed lock
+   discipline) is pinned both on the shipped file and as a minimal
+   reproducer of the bug shape.
+3. Engine unit tests for the subtle clean/flag boundaries: the __init__
+   / unreachable-method exemptions, per-cycle pragma scoping, same-lock
+   re-entry, the family-aware DP503 rendezvous contract, `wait_for`'s
+   built-in predicate loop, closures inheriting their method's class
+   lockset, and timed-vs-untimed queue gets.
+
+Fast lane: ``pytest -m conc`` (part of the `tools/run_tier1.sh --lint`
+CI lane).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import textwrap
+
+import pytest
+
+from tpu_dp.analysis import concurrency
+from tpu_dp.analysis.cli import main as dplint_main
+from tpu_dp.analysis.report import RULES
+
+pytestmark = pytest.mark.conc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "dplint", "conc")
+CONC_RULES = {r for r in RULES if r.startswith("DP5")}
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*(DP\d{3})")
+_ALLOW_RE = re.compile(r"#\s*dplint:\s*allow\(\s*(DP\d{3})")
+
+FIXTURE_FILES = sorted(
+    f for f in os.listdir(FIXTURES) if f.endswith(".py")
+)
+
+
+def _expected_findings(path: str) -> list[tuple[str, int]]:
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, text in enumerate(f, start=1):
+            for m in _EXPECT_RE.finditer(text):
+                out.append((m.group(1), lineno))
+    return out
+
+
+def _run_conc(capsys, argv: list[str]) -> tuple[int, dict]:
+    rc = dplint_main(["conc"] + argv + ["--json"])
+    payload = json.loads(capsys.readouterr().out)
+    return rc, payload
+
+
+# -- 1. every adversarial fixture fires exactly its declared set ----------
+
+@pytest.mark.parametrize("fixture", FIXTURE_FILES)
+def test_fixture_fires_exact_expected_set(fixture, capsys):
+    path = os.path.join(FIXTURES, fixture)
+    expected = set(_expected_findings(path))
+    assert expected, f"{fixture} declares no # EXPECT: comments"
+
+    rc, payload = _run_conc(capsys, [path])
+    assert rc == 1, f"{fixture}: expected exit 1, got {rc}"
+    got = {(f["rule"], f["line"]) for f in payload["findings"]}
+    # Exact equality: a missing violation AND a firing pragma'd twin are
+    # both regressions.
+    assert got == expected, (
+        f"{fixture}: expected exactly {sorted(expected)}, got {sorted(got)}"
+    )
+    for f in payload["findings"]:
+        assert f["path"] == path
+        assert f["rule"] in CONC_RULES
+        assert f["message"]
+
+
+def test_every_conc_rule_has_firing_case_and_pragma_twin():
+    """Both directions per rule, inside the Level-5 fixture set: at
+    least one `# EXPECT: DP50x` firing line AND one `# dplint:
+    allow(DP50x)` twin that the exact-set test above proves silent."""
+    firing: set[str] = set()
+    twinned: set[str] = set()
+    for fixture in FIXTURE_FILES:
+        text = open(os.path.join(FIXTURES, fixture),
+                    encoding="utf-8").read()
+        firing.update(m.group(1) for m in _EXPECT_RE.finditer(text))
+        twinned.update(m.group(1) for m in _ALLOW_RE.finditer(text))
+    assert firing == CONC_RULES, (
+        f"conc rules without a firing fixture: {CONC_RULES - firing}"
+    )
+    assert twinned >= CONC_RULES, (
+        f"conc rules without a pragma'd twin: {CONC_RULES - twinned}"
+    )
+
+
+def test_conc_list_rules(capsys):
+    rc = dplint_main(["conc", "--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for rule in sorted(CONC_RULES):
+        assert rule in out
+
+
+def test_conc_baseline_roundtrip(tmp_path, capsys):
+    """--write-baseline / --baseline wire through the shared machinery:
+    a recorded fixture stops failing, and an unrecorded one still does."""
+    path = os.path.join(FIXTURES, "dp505_blocking_under_lock.py")
+    baseline = tmp_path / "conc_baseline.json"
+    rc = dplint_main(["conc", path, "--write-baseline", str(baseline)])
+    capsys.readouterr()
+    assert rc == 0 and baseline.exists()
+    rc, payload = _run_conc(capsys, [path, "--baseline", str(baseline)])
+    assert rc == 0 and payload["findings"] == []
+    other = os.path.join(FIXTURES, "dp501_unguarded_write.py")
+    rc, payload = _run_conc(capsys, [other, "--baseline", str(baseline)])
+    assert rc == 1 and payload["findings"]
+
+
+# -- 2. the shipped tree is clean -----------------------------------------
+
+def test_shipped_tree_lints_clean(capsys):
+    rc, payload = _run_conc(capsys, [os.path.join(REPO, "tpu_dp")])
+    assert payload["findings"] == []
+    assert rc == 0
+
+
+def test_tampered_copy_planted_in_scratch_package_fails(tmp_path, capsys):
+    """The CI lane's negative direction: a fixture copied into a scratch
+    package (outside tpu_dp/, as `tools/run_tier1.sh --lint` plants it)
+    must still fail with rule+file+line attribution."""
+    pkg = tmp_path / "scratchpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    planted = pkg / "monitor.py"
+    shutil.copy(os.path.join(FIXTURES, "dp501_unguarded_write.py"),
+                planted)
+
+    rc, payload = _run_conc(capsys, [str(tmp_path)])
+    assert rc == 1
+    findings = payload["findings"]
+    assert any(
+        f["rule"] == "DP501" and f["path"] == str(planted) and f["line"] > 0
+        for f in findings
+    )
+
+
+def test_replica_snapshot_lock_discipline_regression():
+    """The real DP501 finding this PR fixed: `snapshot()` must not mix
+    guarded and bare access to the serve thread's status fields. Linting
+    the shipped file pins the fix against reverts."""
+    path = os.path.join(REPO, "tpu_dp", "serve", "replica.py")
+    findings = [f for f in concurrency.lint_file(path)
+                if f.rule == "DP501"]
+    assert findings == []
+
+
+def test_dp501_catches_the_snapshot_status_race_shape():
+    """Minimal reproducer of the replica bug: the serve loop thread
+    writes `self.status` bare while `snapshot()` reads it under
+    `self._lock` — the guarded reader believes the lock excludes the
+    writer, and it does not."""
+    src = """
+    import threading
+
+
+    class Replica:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.status = "idle"
+            self._t = threading.Thread(target=self._loop, daemon=True)
+
+        def _loop(self, stop):
+            while not stop.is_set():
+                self.status = "working"
+
+        def snapshot(self):
+            with self._lock:
+                return {"status": self.status}
+    """
+    findings = _lint(src)
+    assert [f.rule for f in findings] == ["DP501"]
+    assert "status" in findings[0].message
+
+
+# -- 3. engine boundaries --------------------------------------------------
+
+def _lint(src: str, path: str = "fix.py") -> list:
+    return concurrency.lint_source(path, textwrap.dedent(src))
+
+
+def test_dp501_init_and_unreachable_writes_are_exempt():
+    """__init__ runs before the thread exists, and `bump` is not
+    reachable from the Thread target — neither bare write races the
+    guarded reader."""
+    src = """
+    import threading
+
+
+    class Server:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.epoch = 0
+            self._t = threading.Thread(target=self._serve, daemon=True)
+
+        def _serve(self, stop):
+            while not stop.is_set():
+                pass
+
+        def bump(self):
+            self.epoch = self.epoch + 1
+
+        def read(self):
+            with self._lock:
+                return self.epoch
+    """
+    assert _lint(src) == []
+
+
+def test_dp502_same_lock_reenter_is_not_a_cycle():
+    src = """
+    import threading
+
+    r_lock = threading.RLock()
+
+
+    def nested():
+        with r_lock:
+            with r_lock:
+                pass
+    """
+    assert _lint(src) == []
+
+
+def test_dp502_pragma_is_scoped_to_its_own_cycle():
+    """The pragma on the audited c/d cycle must not silence the
+    unrelated a/b deadlock in the same module."""
+    src = """
+    import threading
+
+    a_lock = threading.Lock()
+    b_lock = threading.Lock()
+    c_lock = threading.Lock()
+    d_lock = threading.Lock()
+
+
+    def fwd():
+        with a_lock:
+            with b_lock:
+                pass
+
+
+    def rev():
+        with b_lock:
+            with a_lock:
+                pass
+
+
+    def boot():
+        with c_lock:
+            with d_lock:
+                pass
+
+
+    def teardown():
+        with d_lock:
+            with c_lock:  # dplint: allow(DP502)
+                pass
+    """
+    findings = _lint(src)
+    assert [f.rule for f in findings] == ["DP502"]
+    assert "a_lock" in findings[0].message
+    assert "b_lock" in findings[0].message
+
+
+def test_dp503_trailing_producer_matches_a_gated_await():
+    """A handshake await with no peer branch is answered by its
+    family's producer later in the same suite — a rendezvous, not a
+    wedge."""
+    src = """
+    def establish(ledger, sid, leader, rec):
+        if sid != leader:
+            ledger.await_join_ready(rec)
+        ledger.confirm_join_ready(rec)
+    """
+    assert _lint(src) == []
+
+
+def test_dp503_trailing_copy_does_not_match_a_symmetric_collective():
+    """A symmetric collective is matched only by the peer BRANCH: a
+    second copy after the `if` means the gated ranks run it twice —
+    still divergent."""
+    src = """
+    def regroup(dist, rank, shard):
+        if rank == 0:
+            dist.barrier(shard)
+        dist.barrier(shard)
+    """
+    findings = _lint(src)
+    assert [f.rule for f in findings] == ["DP503"]
+
+
+def test_dp503_raising_guard_is_a_loud_exit_not_a_silent_skip():
+    src = """
+    def settle(dist, plan, sid, shard):
+        if sid not in plan.survivors:
+            raise RuntimeError("evicted")
+        return dist.allgather(shard)
+    """
+    assert _lint(src) == []
+
+
+def test_dp504_wait_for_and_joined_self_handle_are_clean():
+    src = """
+    import threading
+
+
+    class Writer:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+
+        def _run(self):
+            with self._cond:
+                self._cond.wait_for(lambda: True, timeout=1.0)
+
+        def close(self):
+            self._t.join(1.0)
+    """
+    assert _lint(src) == []
+
+
+def test_dp505_untimed_get_flagged_timed_get_clean():
+    src = """
+    import threading
+
+    feed_lock = threading.Lock()
+
+
+    def broken(q):
+        with feed_lock:
+            return q.get()
+
+
+    def bounded(q):
+        with feed_lock:
+            return q.get(timeout=0.5)
+    """
+    findings = _lint(src)
+    assert [f.rule for f in findings] == ["DP505"]
+    assert "untimed" in findings[0].message
+
+
+def test_dp505_closure_inherits_its_methods_class_lock():
+    """`cls_of` fixpoint: a closure defined inside a method holds the
+    CLASS's `self._lock`, so its blocking call under that lock fires."""
+    src = """
+    import threading
+    import time
+
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def run(self):
+            def tick():
+                with self._lock:
+                    time.sleep(0.1)
+            return tick
+    """
+    findings = _lint(src)
+    assert [f.rule for f in findings] == ["DP505"]
+    assert "time.sleep" in findings[0].message
+
+
+def test_dp100_syntax_error_is_reported_not_raised():
+    findings = _lint("def broken(:\n")
+    assert [f.rule for f in findings] == ["DP100"]

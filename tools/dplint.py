@@ -11,17 +11,79 @@ without installing the package:
     tools/dplint.py host               # Level 4: host-protocol rules
                                        # (DP401-DP405) over the tree
     tools/dplint.py host --list-rules  # the Level-4 rule table
+    tools/dplint.py conc               # Level 5: concurrency rules
+                                       # (DP501-DP505) over the tree
+    tools/dplint.py --changed          # lint only files differing from
+                                       # the merge-base (pre-commit loop)
+
+`--changed` composes with every mode (`tools/dplint.py conc --changed`,
+`tools/dplint.py --changed --no-jaxpr --no-hlo`): it resolves the git
+repository of the *current directory*, diffs the working tree against
+the merge-base with the default branch (plus untracked files), and
+substitutes the changed ``.py`` files as the paths to lint. With nothing
+changed it prints a note and exits 0, so an empty pre-commit run passes.
 
 Equivalent to `python -m tpu_dp.analysis`. Exit 0 clean / 1 findings /
 2 internal or usage error (partial findings still rendered on stdout).
 """
 
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tpu_dp.analysis.cli import main  # noqa: E402
 
+
+def _changed_files() -> list[str]:
+    """Working-tree ``.py`` files differing from the merge-base with the
+    default branch, plus untracked ones — the pre-commit question "what
+    did I touch", asked of the repository the user is standing in."""
+
+    def _git(*args: str) -> str:
+        return subprocess.run(
+            ["git", *args], check=True, capture_output=True, text=True,
+        ).stdout
+
+    root = _git("rev-parse", "--show-toplevel").strip()
+    base = "HEAD"
+    for ref in ("origin/main", "main", "origin/master", "master"):
+        try:
+            base = _git("merge-base", "HEAD", ref).strip()
+            break
+        except subprocess.CalledProcessError:
+            continue
+    # On the default branch itself the merge-base IS HEAD, so the diff
+    # degrades to staged + unstaged edits — still the pre-commit answer.
+    names = _git("diff", "--name-only", "--diff-filter=d", base)
+    names += _git("ls-files", "--others", "--exclude-standard")
+    out: list[str] = []
+    for name in names.splitlines():
+        if not name.endswith(".py"):
+            continue
+        path = os.path.join(root, name)
+        if os.path.exists(path) and path not in out:
+            out.append(path)
+    return out
+
+
+def _main() -> int:
+    argv = sys.argv[1:]
+    if "--changed" not in argv:
+        return main(argv)
+    argv = [a for a in argv if a != "--changed"]
+    try:
+        changed = _changed_files()
+    except (subprocess.CalledProcessError, OSError) as e:
+        print(f"dplint: --changed needs a git checkout: {e}",
+              file=sys.stderr)
+        return 2
+    if not changed:
+        print("dplint: no python files differ from the merge-base")
+        return 0
+    return main(argv + changed)
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_main())

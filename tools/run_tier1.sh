@@ -16,12 +16,15 @@
 #                                    # finding. Emits artifacts/
 #                                    # dplint_report.json and artifacts/
 #                                    # collective_fingerprint.json.
-#   tools/run_tier1.sh --lint        # host-protocol lane: dplint Level 4
-#                                    # (DP401-DP405) over the tree (must
-#                                    # be clean; archives artifacts/
-#                                    # hostproto_report.json), a planted
-#                                    # tampered fixture that MUST fail,
-#                                    # then the -m lint tests.
+#   tools/run_tier1.sh --lint        # host-protocol + concurrency lane:
+#                                    # dplint Level 4 (DP401-DP405) AND
+#                                    # Level 5 (DP501-DP505) over the
+#                                    # tree (both must be clean; archives
+#                                    # artifacts/hostproto_report.json +
+#                                    # artifacts/concurrency_report.json),
+#                                    # planted tampered fixtures that
+#                                    # MUST fail per level, then the
+#                                    # -m "lint or conc" tests.
 #   tools/run_tier1.sh --obs         # telemetry lane: a 10-step obs=full
 #                                    # smoke run (archives its metrics.jsonl
 #                                    # and Perfetto trace under artifacts/)
@@ -227,13 +230,15 @@ if [ "${1:-}" = "--dplint" ]; then
 fi
 
 if [ "${1:-}" = "--lint" ]; then
-    # Level 4 host-protocol lane (DP401-DP405), both directions:
-    # 1. the shipped tree must lint clean (exit 0, report archived);
-    # 2. a tampered fixture copy planted into a scratch package MUST
-    #    exit 1 — proving the gate still bites, not just that the tree
-    #    is quiet;
-    # 3. the -m lint pytest suite (fixtures fire exactly, engine
-    #    boundaries, registry invariants).
+    # Host-protocol + concurrency lane (Levels 4 and 5), both directions
+    # for each level:
+    # 1. the shipped tree must lint clean under `host` (DP401-DP405) AND
+    #    `conc` (DP501-DP505) — exit 0, both reports archived;
+    # 2. tampered fixture copies planted into a scratch package MUST
+    #    exit 1 per level — proving each gate still bites, not just that
+    #    the tree is quiet;
+    # 3. the -m "lint or conc" pytest suites (fixtures fire exactly,
+    #    engine boundaries, registry invariants, pragma twins).
     mkdir -p artifacts
     env JAX_PLATFORMS=cpu python -m tpu_dp.analysis host --json \
         > artifacts/hostproto_report.json
@@ -241,6 +246,14 @@ if [ "${1:-}" = "--lint" ]; then
     if [ "$rc" -ne 0 ]; then
         cat artifacts/hostproto_report.json
         echo "run_tier1 --lint: shipped tree is not hostproto-clean" >&2
+        exit "$rc"
+    fi
+    env JAX_PLATFORMS=cpu python -m tpu_dp.analysis conc --json \
+        > artifacts/concurrency_report.json
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        cat artifacts/concurrency_report.json
+        echo "run_tier1 --lint: shipped tree is not concurrency-clean" >&2
         exit "$rc"
     fi
     SCRATCH=$(mktemp -d /tmp/tpu_dp_lint_scratch.XXXXXX) || exit 1
@@ -255,9 +268,19 @@ if [ "${1:-}" = "--lint" ]; then
         rm -rf "$SCRATCH"
         exit 1
     fi
+    rm "$SCRATCH/scratchpkg/ledger.py"
+    cp tests/fixtures/dplint/conc/dp501_unguarded_write.py \
+        "$SCRATCH/scratchpkg/monitor.py"
+    if env JAX_PLATFORMS=cpu python -m tpu_dp.analysis conc "$SCRATCH" \
+        > /dev/null; then
+        echo "run_tier1 --lint: planted DP501 fixture did NOT fail the" \
+             "gate — the concurrency lane is toothless" >&2
+        rm -rf "$SCRATCH"
+        exit 1
+    fi
     rm -rf "$SCRATCH"
-    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m lint \
-        -p no:cacheprovider
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m 'lint or conc' -p no:cacheprovider
 fi
 
 if [ "${1:-}" = "--obs" ]; then

@@ -1,0 +1,199 @@
+"""Shared one-level call-graph resolution for the host-side dplint levels.
+
+Levels 4 (hostproto, DP4xx) and 5 (concurrency, DP5xx) both reason one
+call level deep inside a single module: "the write is routed because the
+enclosing helper is handed to `retry_call`", "the loop is bounded because
+a function it calls every turn owns the deadline", "the lock is ordered
+because the method called under it takes the second lock". That shared
+machinery — package-relative scoping, innermost-enclosing-def lookup,
+statement walks that do not descend into closures, router discovery and
+scope-aware routed-function resolution — was born inside
+`tpu_dp/analysis/hostproto.py` and is extracted here verbatim so Level 5
+cannot fork its semantics. hostproto's 22 pinned tests
+(`tests/test_hostproto.py`) gate the port: the helpers must answer
+exactly what they answered in place.
+
+Everything here is pure-AST and import-free of JAX: the analysis CLI must
+run on a machine with no accelerator runtime at all.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+__all__ = [
+    "pkg_rel",
+    "in_scope",
+    "last_segment",
+    "function_index",
+    "enclosing_function",
+    "walk_skipping_defs",
+    "local_callables",
+    "call_routers",
+    "routed_functions",
+]
+
+
+# --------------------------------------------------------------------------
+# path scoping
+# --------------------------------------------------------------------------
+
+
+def pkg_rel(path: str) -> str | None:
+    """Path relative to the ``tpu_dp`` package (posix), or None if outside."""
+    p = os.path.abspath(path).replace(os.sep, "/")
+    marker = "/tpu_dp/"
+    idx = p.rfind(marker)
+    if idx < 0:
+        return None
+    return p[idx + len(marker):]
+
+
+def in_scope(path: str, prefixes: tuple[str, ...]) -> bool:
+    """True when ``path`` is inside the package under one of ``prefixes``.
+
+    Files *outside* the package (adversarial fixtures, scratch copies)
+    are always in scope — a planted violation must fire wherever CI
+    plants it.
+    """
+    rel = pkg_rel(path)
+    if rel is None:
+        return True
+    return rel.startswith(prefixes)
+
+
+# --------------------------------------------------------------------------
+# AST structure
+# --------------------------------------------------------------------------
+
+
+def last_segment(dotted: str | None) -> str | None:
+    """Final attribute of a dotted name (``a.b.c`` -> ``c``)."""
+    return None if dotted is None else dotted.rsplit(".", 1)[-1]
+
+
+def function_index(tree: ast.Module) -> list[ast.AST]:
+    """Every (async) function def in the module, in walk order."""
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def enclosing_function(tree: ast.Module, node: ast.AST) -> ast.AST | None:
+    """Innermost def containing ``node`` (by position), or None (module).
+
+    ``node`` itself is excluded from the candidates: for a def node this
+    must return the def's PARENT function (a closure's own span contains
+    its ``def`` line, and answering "itself" made router resolution
+    check whether the router call sits inside the routed closure — it
+    never does, so pure retry-routing silently stopped matching).
+    """
+    best = None
+    best_span = None
+    line = node.lineno
+    end = getattr(node, "end_lineno", line) or line
+    for fn in function_index(tree):
+        if fn is node:
+            continue
+        f_end = fn.end_lineno or fn.lineno
+        if fn.lineno <= line and end <= f_end:
+            span = f_end - fn.lineno
+            if best_span is None or span < best_span:
+                best, best_span = fn, span
+    return best
+
+
+def walk_skipping_defs(nodes: Iterable[ast.AST]):
+    """Walk statements without descending into nested function bodies —
+    a closure defined inside a loop runs on its own schedule, not the
+    loop's, so its calls are not the loop's calls."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def local_callables(tree: ast.Module) -> dict[str, ast.AST]:
+    """Name -> def node for every function in the module (last def wins
+    for duplicate names, matching runtime rebinding)."""
+    return {fn.name: fn for fn in function_index(tree)}
+
+
+# --------------------------------------------------------------------------
+# router discovery + routed-function resolution (one level deep)
+# --------------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> str | None:
+    # Local copy of astlint._dotted so this module stays dependency-light
+    # in both directions (astlint imports nothing from here either).
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+def call_routers(tree: ast.Module, seeds: Iterable[str]) -> set[str]:
+    """The ``seeds`` plus every local function whose body calls one —
+    the one-level interprocedural discovery that recognizes
+    ``elastic._ledger_io`` and ``checkpoint._io_retry`` as retry routers
+    when seeded with ``{"retry_call"}``."""
+    routers = set(seeds)
+    seed_names = set(routers)
+    for fn in function_index(tree):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    last_segment(_dotted(node.func)) in seed_names:
+                routers.add(fn.name)
+                break
+    return routers
+
+
+def routed_functions(tree: ast.Module, routers: set[str]) -> set[int]:
+    """Node ids of function defs passed by name into a router call.
+
+    Resolution is scope-aware on purpose: two closures named ``_write``
+    in different functions are different functions, and
+    ``_io_retry(_write)`` inside one must not launder the other — that
+    exact aliasing is how the unrouted latest-pointer publish in
+    `CheckpointManager.save` hid from the first draft of DP401.
+    """
+    defs_by_name: dict[str, list[ast.AST]] = {}
+    for fn in function_index(tree):
+        defs_by_name.setdefault(fn.name, []).append(fn)
+
+    routed: set[int] = set()
+
+    def _resolve(name: str, call: ast.Call, attr: bool) -> None:
+        for d in defs_by_name.get(name, ()):
+            if attr:
+                # self._write / obj.method: dynamic dispatch — any
+                # same-named def may be the target.
+                routed.add(id(d))
+                continue
+            parent = enclosing_function(tree, d)
+            if parent is None:
+                routed.add(id(d))  # module-level def, module-wide name
+                continue
+            p_end = parent.end_lineno or parent.lineno
+            if parent.lineno <= call.lineno <= p_end:
+                routed.add(id(d))  # closure referenced from its scope
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if last_segment(_dotted(node.func)) not in routers:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Name):
+                _resolve(arg.id, node, attr=False)
+            elif isinstance(arg, ast.Attribute):
+                _resolve(arg.attr, node, attr=True)
+    return routed

@@ -13,6 +13,11 @@ Runs three levels over the given paths:
   (`tpu_dp.analysis.hostproto`) — IO-seam routing, unbounded polls,
   wall-clock deadlines, flightrec kind and counter name drift. Runs as
   `python -m tpu_dp.analysis host [paths...]`; pure AST, no jax.
+- **Level 5 (concurrency, via the `conc` subcommand)**: DP501–DP505
+  (`tpu_dp.analysis.concurrency`) — per-attribute locksets, lock-order
+  cycles, rank-gated collective-participation divergence, thread
+  lifecycles, locks held across blocking calls. Runs as
+  `python -m tpu_dp.analysis conc [paths...]`; pure AST, no jax.
 - **Level 3 (HLO, unless --no-hlo)**: the compiled-artifact pass
   (DP301–DP304). The shipped step programs are lowered and compiled on an
   abstract `--world`-device data mesh and the optimized HLO is verified
@@ -38,7 +43,7 @@ import importlib.util
 import os
 import sys
 
-from tpu_dp.analysis import astlint, coupling, donation, recompile
+from tpu_dp.analysis import astlint, coupling, donation, pragmas, recompile
 from tpu_dp.analysis.report import (
     Finding,
     apply_baseline,
@@ -120,22 +125,14 @@ def _setup_backend(world: int) -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
-def host_main(argv: list[str]) -> int:
-    """`python -m tpu_dp.analysis host [paths...]`: the Level-4 pass.
-
-    Runs only DP401–DP405 (`tpu_dp.analysis.hostproto`) — pure AST, no
-    jax, no tracing — over the given paths (default: the whole tpu_dp
-    package, so DP404's rendered-kind-is-emitted check sees the real
-    emit sites in train/ and utils/, not just the protocol packages the
-    findings are scoped to). Shares the report/baseline/pragma
-    machinery and exit codes with the main driver.
-    """
-    parser = argparse.ArgumentParser(
-        prog="dplint host",
-        description="host-protocol static analysis (DP401-DP405): "
-                    "IO-seam routing, unbounded polls, wall-clock "
-                    "deadlines, flightrec kind and counter name drift",
-    )
+def _ast_level_main(argv: list[str], *, prog: str, description: str,
+                    rule_prefix: str, lint_paths) -> int:
+    """Shared driver for the pure-AST subcommand levels (4: ``host``,
+    5: ``conc``): paths / --json / --baseline / --write-baseline /
+    --list-rules over the given ``lint_paths`` pass, with the same
+    report/baseline/pragma machinery and exit codes as the main driver.
+    No jax import anywhere on this path."""
+    parser = argparse.ArgumentParser(prog=prog, description=description)
     parser.add_argument("paths", nargs="*", default=None,
                         help="files or directories to analyze "
                              "(default: the tpu_dp package)")
@@ -148,16 +145,16 @@ def host_main(argv: list[str]) -> int:
                         help="write the current findings' fingerprints to "
                              "FILE and exit 0")
     parser.add_argument("--list-rules", action="store_true",
-                        help="print the Level-4 rule table and exit")
+                        help=f"print the {rule_prefix}xx rule table and "
+                             f"exit")
     args = parser.parse_args(argv)
 
-    from tpu_dp.analysis import hostproto
     from tpu_dp.analysis.report import RULES
 
     if args.list_rules:
         lines = []
         for rule, (title, failure) in RULES.items():
-            if rule.startswith("DP4"):
+            if rule.startswith(rule_prefix):
                 lines.append(f"{rule}  {title}")
                 lines.append(f"       {failure}")
         print("\n".join(lines))
@@ -175,7 +172,7 @@ def host_main(argv: list[str]) -> int:
     findings: list[Finding] = []
     internal_error: str | None = None
     try:
-        findings = hostproto.lint_paths(paths)
+        findings = lint_paths(paths)
     except Exception as e:
         import traceback
 
@@ -205,14 +202,56 @@ def host_main(argv: list[str]) -> int:
     return 1 if findings else 0
 
 
+def host_main(argv: list[str]) -> int:
+    """`python -m tpu_dp.analysis host [paths...]`: the Level-4 pass.
+
+    Runs only DP401–DP405 (`tpu_dp.analysis.hostproto`) — pure AST, no
+    jax, no tracing — over the given paths (default: the whole tpu_dp
+    package, so DP404's rendered-kind-is-emitted check sees the real
+    emit sites in train/ and utils/, not just the protocol packages the
+    findings are scoped to).
+    """
+    from tpu_dp.analysis import hostproto
+
+    return _ast_level_main(
+        argv, prog="dplint host",
+        description="host-protocol static analysis (DP401-DP405): "
+                    "IO-seam routing, unbounded polls, wall-clock "
+                    "deadlines, flightrec kind and counter name drift",
+        rule_prefix="DP4", lint_paths=hostproto.lint_paths,
+    )
+
+
+def conc_main(argv: list[str]) -> int:
+    """`python -m tpu_dp.analysis conc [paths...]`: the Level-5 pass.
+
+    Runs only DP501–DP505 (`tpu_dp.analysis.concurrency`) — pure AST,
+    no jax — over the given paths (default: the whole tpu_dp package;
+    the rules self-scope to the threaded host modules).
+    """
+    from tpu_dp.analysis import concurrency
+
+    return _ast_level_main(
+        argv, prog="dplint conc",
+        description="concurrency & collective-participation static "
+                    "analysis (DP501-DP505): locksets, lock-order "
+                    "cycles, rank-gated participation divergence, "
+                    "thread lifecycles, locks held across blocking "
+                    "calls",
+        rule_prefix="DP5", lint_paths=concurrency.lint_paths,
+    )
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
-    # `dplint host ...` dispatches to the Level-4 host-protocol pass
-    # before the device-program parser sees the argv (it has its own
-    # flag surface and never touches jax).
+    # `dplint host ...` / `dplint conc ...` dispatch to the pure-AST
+    # Level-4/Level-5 passes before the device-program parser sees the
+    # argv (they have their own flag surface and never touch jax).
     if argv and argv[0] == "host":
         return host_main(argv[1:])
+    if argv and argv[0] == "conc":
+        return conc_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="dplint",
         description="static SPMD-correctness analyzer for tpu_dp "
@@ -271,11 +310,11 @@ def main(argv: list[str] | None = None) -> int:
 
     findings: list[Finding] = []
     internal_error: str | None = None
+    sources: dict[str, str] = {}
     try:
         # One read per file; AST lint, donation check, retrace lint, and
         # hook discovery all work from the same source text.
         files = astlint.iter_py_files(paths)
-        sources: dict[str, str] = {}
         hooks: dict[str, set[str]] = {}
         for f in files:
             with open(f, encoding="utf-8") as fh:
@@ -350,6 +389,13 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         internal_error = f"{type(e).__name__}: {e}"
 
+    # The trace-level passes (jaxpr/HLO hooks) honor the same allow-pragma
+    # machinery as the AST passes: a pragma on the finding's attributed
+    # line — the hook program's `def` line — suppresses it. The AST rules
+    # already self-filtered with their own (wider) extra-line placement,
+    # so re-checking the bare line here is a no-op for them.
+    findings = _apply_pragmas(findings, sources)
+
     # The baseline is written from the PRE-suppression findings: the
     # natural in-place refresh `--baseline ci.json --write-baseline ci.json`
     # must re-record the still-present findings, not empty the file.
@@ -375,6 +421,24 @@ def main(argv: list[str] | None = None) -> int:
     if internal_error:
         return 2
     return 1 if findings else 0
+
+
+def _apply_pragmas(findings: list[Finding],
+                   sources: dict[str, str]) -> list[Finding]:
+    """Drop findings whose attributed line carries an allow-pragma for
+    their rule, for files whose source this run already read."""
+    cache: dict[str, dict[int, set[str]]] = {}
+    out: list[Finding] = []
+    for f in findings:
+        src = sources.get(f.path)
+        if src is not None:
+            allowed = cache.get(f.path)
+            if allowed is None:
+                allowed = cache[f.path] = pragmas.collect(src)
+            if pragmas.is_allowed(allowed, f.rule, (f.line,)):
+                continue
+        out.append(f)
+    return out
 
 
 def _run_hlo_pass(args, files, hooks, modules, has_repo_step,

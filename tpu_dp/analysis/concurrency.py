@@ -1,0 +1,934 @@
+"""Level-5 dplint: concurrency & collective-participation rules DP501–DP505.
+
+Levels 1–3 prove the *device* program, Level 4 the host *IO protocol*.
+What neither proves is the host control plane's **concurrency**: the
+serve router/queue/replica threads, the prefetch pipeline's producer, the
+checkpoint writer thread, the heartbeat monitor — and whether every rank
+walks the same collective/handshake sequence. The two worst bugs the
+chaos harness (PR 14) ever found were exactly this class, caught only
+dynamically: a rank-local quiesce read let one rank skip an allgather its
+peers entered, wedging the whole mesh. Level 5 makes that bug class (and
+the classic lock bugs around it) a lint failure:
+
+- DP501 — **unguarded shared write**: a ``self.X = ...`` write reachable
+  from a ``threading.Thread`` target while OTHER access sites of the
+  same attribute hold a lock (per-``self``-attribute lockset over
+  ``with self._lock:`` blocks). Mixed guard discipline is the race: the
+  guarded readers believe the lock excludes the writer, and it doesn't.
+  ``__init__`` writes are exempt (the thread does not exist yet).
+- DP502 — **lock-order cycle**: ``with a:`` containing (directly, or one
+  same-module call down) ``with b:`` adds the edge a→b; a cycle in that
+  acquisition graph is the static deadlock. Same-lock self-edges are not
+  reported (an RLock re-enter is legal; a plain-Lock re-enter is a
+  different bug with a different shape).
+- DP503 — **divergent collective participation**: a *blocking*
+  participation call — a symmetric collective (``barrier``,
+  ``allreduce``, ``allgather``, ``broadcast``, ``membership_barrier``,
+  the native ``ring_*`` family) or a ledger-handshake await
+  (``await_epoch``/``await_quiesced``/``await_join_ready``/
+  ``await_grow_verdict``) — dominated by a rank- or leader-dependent
+  conditional with no matching participation on the peer path. Matching
+  is family-aware: the leader's ``publish_epoch`` answers the peers'
+  ``await_epoch`` (a rendezvous, not a wedge), but a symmetric
+  collective is matched only by ITSELF — every rank must make the same
+  call. A rank-gated early return followed by a collective later in the
+  same suite is the exact PR 14 quiesce-gate wedge and fires too.
+- DP504 — **thread lifecycle**: a non-daemon thread whose handle is
+  never ``.join()``-ed anywhere in the module (or never stored at all);
+  a daemon thread whose target loops (``while``) with no stop-flag in
+  sight (no ``*stop*``/``*done*``/``*running*`` identifier, no
+  ``.is_set()``) — unstoppable service loops outlive every drain path;
+  and a ``Condition.wait`` outside a predicate ``while`` — a bare wait
+  misses wakeups and wakes spuriously, both by spec.
+- DP505 — **lock held across a blocking call**: inside a ``with <lock>:``
+  block (directly or one same-module call down) a durable write
+  (``.write_text``/``.write_bytes``/``.touch``/``os.replace``/
+  ``fsync``), ``time.sleep``, an untimed zero-arg ``.get()``/
+  ``.acquire()``/``.join()``, a ``subprocess`` call, a host collective,
+  or a device sync (``block_until_ready``) — in the serve/pipeline hot
+  paths every peer of that lock stalls behind the slow operation.
+
+Scoping: rules self-scope by path like Level 4. The Level-5 scope is the
+threaded host control plane (``serve/``, ``data/pipeline.py``,
+``checkpoint.py``, ``resilience/``, ``obs/health.py``,
+``ops/native/hostlib.py``); DP505 narrows further to the latency-
+sensitive hot paths (``serve/``, ``data/pipeline.py``) plus the native
+collective host library (whose module lock brackets its TCP ring).
+Files *outside* the package (adversarial fixtures, scratch copies) get
+every rule — a planted violation must fire wherever CI plants it.
+
+The analysis is lexical and one call level deep on purpose (shared
+machinery: `tpu_dp.analysis.callgraph`): ``lock.acquire()``/
+``release()`` pairs, cross-module aliasing, and thread identities
+flowing through containers are invisible to it. The rules are tuned so
+the shipped tree's deliberate patterns (Condition waits inside predicate
+loops, flag-bounded daemon loops, the donated-buffer bracket) either
+pass by construction or carry an audit pragma
+(``# dplint: allow(DP50x) <why>``); `python -m tpu_dp.analysis conc`
+is the CLI entry (exit 0 clean / 1 findings / 2 internal), and
+``tools/run_tier1.sh --lint`` is the CI lane enforcing both directions.
+docs/ANALYSIS.md "Level 5 — concurrency" is the prose contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, NamedTuple
+
+from tpu_dp.analysis import pragmas
+from tpu_dp.analysis.astlint import (
+    _dotted,
+    iter_py_files,
+    scope_at,
+    scope_index,
+)
+from tpu_dp.analysis.callgraph import (
+    enclosing_function,
+    function_index,
+    in_scope,
+    last_segment,
+    local_callables,
+    walk_skipping_defs,
+)
+from tpu_dp.analysis.report import Finding
+
+# --------------------------------------------------------------------------
+# scoping
+# --------------------------------------------------------------------------
+
+#: package-relative prefixes forming the Level-5 scope: every module that
+#: creates threads, shares state across them, or walks the regroup
+#: handshake.
+_CONC_PREFIXES = (
+    "serve/", "data/pipeline.py", "checkpoint.py", "resilience/",
+    "obs/health.py", "ops/native/hostlib.py",
+)
+
+#: DP505 narrows to the hot paths where a stalled lock is a latency or
+#: liveness bug (plus the native host library, whose module lock brackets
+#: the subprocess build and the TCP ring).
+_DP505_PREFIXES = ("serve/", "data/pipeline.py", "ops/native/hostlib.py")
+
+
+def conc_applies(path: str) -> bool:
+    return in_scope(path, _CONC_PREFIXES)
+
+
+def dp505_applies(path: str) -> bool:
+    return in_scope(path, _DP505_PREFIXES)
+
+
+# --------------------------------------------------------------------------
+# vocabulary
+# --------------------------------------------------------------------------
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_CONDITION_FACTORY = "Condition"
+
+#: identifier shapes recognized as locks at a `with` context even without
+#: a visible `threading.*()` assignment (a lock handed in as a ctor
+#: parameter — the serve tree shares `_books_lock` that way).
+_LOCKISH = re.compile(r"(?:^|_)(?:lock|mutex|cond|cv)(?:$|_)|lock$",
+                      re.IGNORECASE)
+
+#: symmetric collectives: every rank must make the SAME call — matching
+#: participation on a peer path means the same callee name.
+_SYMMETRIC = {
+    "barrier", "membership_barrier", "fault_tolerant_barrier",
+    "allreduce", "allgather", "all_gather", "all_reduce", "broadcast",
+    "reduce_scatter", "ring_allreduce", "ring_barrier",
+}
+
+#: ledger-handshake families: a blocking await on one side is matched by
+#: the family's producer on the peer side (the leader publishes what the
+#: peers await — a rendezvous, not a wedge).
+_HANDSHAKE_FAMILY = {
+    "publish_epoch": "epoch record", "write_initial": "epoch record",
+    "await_epoch": "epoch record",
+    "check_in": "quiesce ack", "ack_quiesced": "quiesce ack",
+    "await_quiesced": "quiesce ack",
+    "confirm_join_ready": "join-ready", "await_join_ready": "join-ready",
+    "publish_grow_verdict": "grow verdict",
+    "await_grow_verdict": "grow verdict",
+}
+
+#: the blocking side of participation: the calls that WEDGE when peers
+#: diverge. Producers (publishes, acks, check-ins) are one-sided writes
+#: and never block on a peer.
+_BLOCKING_PARTICIPATION = _SYMMETRIC | {
+    "await_epoch", "await_quiesced", "await_join_ready",
+    "await_grow_verdict",
+}
+
+#: identifiers whose presence in an `if` test marks it rank/leader-
+#: dependent (`self.sid == leader`, `jax.process_index() == 0`, ...).
+_RANK_TOKENS = {"rank", "sid", "leader", "is_leader", "process_index",
+                "local_rank", "world_rank", "node_rank", "is_coordinator",
+                "is_primary"}
+
+#: identifiers that count as a stop-flag reference inside a daemon
+#: thread's service loop (DP504).
+_STOPFLAG = re.compile(
+    r"stop|shutdown|done|exit|quit|halt|closed|running|alive|draining",
+    re.IGNORECASE)
+
+_DURABLE_WRITE_ATTRS = {"write_text", "write_bytes", "touch", "fsync",
+                        "replace", "rename", "renames"}
+_SUBPROCESS_CALLS = {"run", "check_call", "check_output", "call",
+                     "communicate", "Popen"}
+
+
+def _participation_family(name: str | None) -> str | None:
+    if name is None:
+        return None
+    if name in _SYMMETRIC:
+        return name  # a symmetric collective is its own family
+    return _HANDSHAKE_FAMILY.get(name)
+
+
+def _is_rank_gated(test: ast.AST) -> bool:
+    """True when the `if` test depends on rank/leader identity."""
+    for sub in ast.walk(test):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Call):
+            name = last_segment(_dotted(sub.func))
+        if name is None:
+            continue
+        low = name.lower()
+        if low in _RANK_TOKENS or low.endswith("_rank"):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# lockset walking
+# --------------------------------------------------------------------------
+
+
+class _Site(NamedTuple):
+    attr: str
+    kind: str                 # "read" | "write"
+    line: int
+    method: str
+    held: frozenset
+
+
+def _expr_nodes(stmt: ast.AST):
+    """The statement and its expression children, skipping nested defs."""
+    yield from walk_skipping_defs([stmt])
+
+
+def _held_nodes(body: list[ast.AST], held: frozenset, lock_of):
+    """Yield (node, held-lockset) for every node in ``body``.
+
+    ``with <lock>:`` grows the set for its body; nested function/class
+    defs are skipped (a closure runs on its own schedule — its
+    acquisitions are its own). Try/if/for/while bodies inherit the
+    current set.
+    """
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            yield stmt, held
+            acquired = set()
+            for item in stmt.items:
+                for sub in walk_skipping_defs([item]):
+                    yield sub, held
+                key = lock_of(item.context_expr)
+                if key is not None:
+                    acquired.add(key)
+            yield from _held_nodes(stmt.body, held | frozenset(acquired),
+                                   lock_of)
+        elif isinstance(stmt, (ast.If, ast.While, ast.For, ast.AsyncFor,
+                               ast.Try)):
+            yield stmt, held
+            for field, value in ast.iter_fields(stmt):
+                if field in ("body", "orelse", "finalbody", "handlers"):
+                    continue
+                values = value if isinstance(value, list) else [value]
+                for v in values:
+                    if isinstance(v, ast.AST):
+                        for sub in walk_skipping_defs([v]):
+                            yield sub, held
+            yield from _held_nodes(stmt.body, held, lock_of)
+            yield from _held_nodes(getattr(stmt, "orelse", []), held,
+                                   lock_of)
+            yield from _held_nodes(getattr(stmt, "finalbody", []), held,
+                                   lock_of)
+            for handler in getattr(stmt, "handlers", []):
+                yield handler, held
+                yield from _held_nodes(handler.body, held, lock_of)
+        else:
+            for sub in _expr_nodes(stmt):
+                yield sub, held
+
+
+# --------------------------------------------------------------------------
+# the per-file linter
+# --------------------------------------------------------------------------
+
+
+class _ConcLinter:
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.allowed = pragmas.collect(source)
+        self.findings: list[Finding] = []
+        self._scopes: list[tuple[int, int, str]] = []
+
+    def _emit(self, rule: str, line: int, message: str,
+              extra_lines: tuple[int, ...] = ()) -> None:
+        if pragmas.is_allowed(self.allowed, rule, (line,) + extra_lines):
+            return
+        self.findings.append(Finding(
+            rule, self.path, line, message,
+            symbol=scope_at(self._scopes, line),
+        ))
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.source, filename=self.path)
+        except SyntaxError as e:
+            self.findings.append(Finding(
+                "DP100", self.path, e.lineno or 1,
+                f"file does not parse: {e.msg}",
+            ))
+            return self.findings
+        self._scopes = scope_index(tree)
+        self._tree = tree
+        self._index(tree)
+
+        if conc_applies(self.path):
+            self._check_dp501(tree)
+            self._check_dp502(tree)
+            self._check_dp503(tree)
+            self._check_dp504(tree)
+        if dp505_applies(self.path):
+            self._check_dp505(tree)
+        return self.findings
+
+    # -- shared model ---------------------------------------------------
+
+    def _index(self, tree: ast.Module) -> None:
+        self._local_fns = local_callables(tree)
+        # class of each def (closures inherit their enclosing method's)
+        cls_of: dict[int, str] = {}
+        self._class_defs: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                methods = [d for d in node.body
+                           if isinstance(d, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))]
+                self._class_defs[node.name] = methods
+                for d in methods:
+                    cls_of[id(d)] = node.name
+        changed = True
+        while changed:
+            changed = False
+            for fn in function_index(tree):
+                if id(fn) in cls_of:
+                    continue
+                parent = enclosing_function(tree, fn)
+                if parent is not None and id(parent) in cls_of:
+                    cls_of[id(fn)] = cls_of[id(parent)]
+                    changed = True
+        self._cls_of = cls_of
+
+        # declared locks: module-level `x = threading.Lock()` names, and
+        # per-class `self.x = threading.Lock()` attrs. Condition objects
+        # tracked separately for DP504's predicate-while check.
+        self._module_locks: set[str] = set()
+        self._module_conds: set[str] = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                factory = last_segment(_dotted(node.value.func))
+                if factory in _LOCK_FACTORIES:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self._module_locks.add(t.id)
+                            if factory == _CONDITION_FACTORY:
+                                self._module_conds.add(t.id)
+        self._attr_locks: dict[str, set[str]] = {}
+        self._attr_conds: dict[str, set[str]] = {}
+        for fn in function_index(tree):
+            cls = cls_of.get(id(fn))
+            if cls is None:
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                factory = last_segment(_dotted(node.value.func))
+                if factory not in _LOCK_FACTORIES:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        self._attr_locks.setdefault(cls, set()).add(t.attr)
+                        if factory == _CONDITION_FACTORY:
+                            self._attr_conds.setdefault(cls,
+                                                        set()).add(t.attr)
+
+        # threading.Thread creation sites: (call, target-name, daemon,
+        # handle) where handle is the "self.x"/"name" the Thread object
+        # is stored into (None: fire-and-forget).
+        self._threads: list[tuple[ast.Call, str | None, bool,
+                                  str | None]] = []
+        parents: dict[int, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        self._parents = parents
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and last_segment(_dotted(node.func)) == "Thread"):
+                continue
+            target_name = None
+            daemon = False
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    if isinstance(kw.value, ast.Attribute):
+                        target_name = kw.value.attr
+                    elif isinstance(kw.value, ast.Name):
+                        target_name = kw.value.id
+                elif kw.arg == "daemon":
+                    daemon = (isinstance(kw.value, ast.Constant)
+                              and bool(kw.value.value))
+            handle = None
+            parent = parents.get(id(node))
+            if isinstance(parent, ast.Assign):
+                for t in parent.targets:
+                    if isinstance(t, ast.Name):
+                        handle = t.id
+                    elif isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        handle = f"self.{t.attr}"
+            self._threads.append((node, target_name, daemon, handle))
+        self._thread_target_names = {t for _, t, _, _ in self._threads
+                                     if t is not None}
+
+    def _lock_of(self, cls: str | None):
+        """A `with`-context classifier scoped to ``cls``: lock keys are
+        ``Class::self.attr`` / ``<module>::name`` so two classes' private
+        ``self._lock`` attributes never alias in the acquisition graph."""
+        attr_locks = self._attr_locks.get(cls or "", set())
+
+        def lock_of(expr: ast.AST) -> str | None:
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self":
+                if expr.attr in attr_locks or _LOCKISH.search(expr.attr):
+                    return f"{cls or '<class>'}::self.{expr.attr}"
+            elif isinstance(expr, ast.Name):
+                if expr.id in self._module_locks or \
+                        _LOCKISH.search(expr.id):
+                    return f"<module>::{expr.id}"
+            return None
+
+        return lock_of
+
+    @staticmethod
+    def _lock_name(key: str) -> str:
+        return key.split("::", 1)[1]
+
+    # -- DP501: unguarded shared-attribute write ------------------------
+
+    def _reachable_methods(self, cls: str) -> set[str]:
+        """Method names of ``cls`` reachable from a Thread target: the
+        targets themselves plus everything they call via ``self.`` —
+        one call level, per the shared resolution depth."""
+        methods = {m.name: m for m in self._class_defs.get(cls, ())}
+        reachable = {n for n in methods if n in self._thread_target_names}
+        for name in sorted(reachable):
+            for node in walk_skipping_defs(methods[name].body):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self" and \
+                        node.func.attr in methods:
+                    reachable = reachable | {node.func.attr}
+        return reachable
+
+    def _check_dp501(self, tree: ast.Module) -> None:
+        if not self._threads:
+            return
+        for cls, methods in self._class_defs.items():
+            reachable = self._reachable_methods(cls)
+            if not reachable:
+                continue
+            lock_of = self._lock_of(cls)
+            method_names = {m.name for m in methods}
+            lock_attrs = self._attr_locks.get(cls, set())
+            sites: dict[str, list[_Site]] = {}
+            for m in methods:
+                for node, held in _held_nodes(m.body, frozenset(),
+                                              lock_of):
+                    if not (isinstance(node, ast.Attribute)
+                            and isinstance(node.value, ast.Name)
+                            and node.value.id == "self"):
+                        continue
+                    attr = node.attr
+                    if attr in lock_attrs or attr in method_names or \
+                            _LOCKISH.search(attr):
+                        continue
+                    kind = ("write" if isinstance(node.ctx,
+                                                  (ast.Store, ast.Del))
+                            else "read")
+                    sites.setdefault(attr, []).append(
+                        _Site(attr, kind, node.lineno, m.name, held))
+            for attr, slist in sorted(sites.items()):
+                guarded = [s for s in slist if s.held]
+                if not guarded:
+                    continue
+                locks = sorted({self._lock_name(k)
+                                for s in guarded for k in s.held})
+                bad = [s for s in slist
+                       if not s.held and s.kind == "write"
+                       and s.method in reachable
+                       and s.method != "__init__"]
+                seen_methods: set[str] = set()
+                for s in sorted(bad, key=lambda s: s.line):
+                    if s.method in seen_methods:
+                        continue
+                    seen_methods.add(s.method)
+                    self._emit(
+                        "DP501", s.line,
+                        f"`self.{attr}` is written without a lock in "
+                        f"`{cls}.{s.method}` — a method reachable from a "
+                        f"`threading.Thread` target — while its other "
+                        f"access sites hold {locks}: the guarded readers "
+                        f"believe the lock excludes this writer, and it "
+                        f"does not; take the lock around the write, or "
+                        f"audit a deliberately benign publish with "
+                        f"`# dplint: allow(DP501)`",
+                        extra_lines=(s.line - 1,),
+                    )
+
+    # -- DP502: lock-acquisition-order cycles ---------------------------
+
+    def _callee_acquisitions(self, callee: ast.AST,
+                             lock_of) -> list[tuple[str, int]]:
+        out = []
+        for node, held in _held_nodes(callee.body, frozenset(), lock_of):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    key = lock_of(item.context_expr)
+                    if key is not None:
+                        out.append((key, node.lineno))
+        return out
+
+    def _check_dp502(self, tree: ast.Module) -> None:
+        # edge (a, b) -> (line, function) of the first a-held-acquire-b
+        edges: dict[tuple[str, str], tuple[int, str]] = {}
+        for fn in function_index(tree):
+            cls = self._cls_of.get(id(fn))
+            lock_of = self._lock_of(cls)
+            for node, held in _held_nodes(fn.body, frozenset(), lock_of):
+                if not held:
+                    continue
+                acquired: list[tuple[str, int]] = []
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        key = lock_of(item.context_expr)
+                        if key is not None:
+                            acquired.append((key, node.lineno))
+                elif isinstance(node, ast.Call):
+                    callee = self._resolve_local_call(node)
+                    if callee is not None and callee is not fn:
+                        callee_cls = self._cls_of.get(id(callee))
+                        acquired = [
+                            (k, node.lineno) for k, _ in
+                            self._callee_acquisitions(
+                                callee, self._lock_of(callee_cls))
+                        ]
+                for b, line in acquired:
+                    for a in held:
+                        if a == b:
+                            continue
+                        edges.setdefault((a, b), (line, fn.name))
+        # cycle detection over the acquisition digraph
+        graph: dict[str, set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        reported: set[frozenset] = set()
+        for start in sorted(graph):
+            path: list[str] = []
+
+            def dfs(n: str) -> list[str] | None:
+                if n in path:
+                    return path[path.index(n):]
+                path.append(n)
+                for nxt in sorted(graph.get(n, ())):
+                    cyc = dfs(nxt)
+                    if cyc is not None:
+                        return cyc
+                path.pop()
+                return None
+
+            cycle = dfs(start)
+            if cycle is None:
+                continue
+            key = frozenset(cycle)
+            if key in reported:
+                continue
+            reported.add(key)
+            ring = cycle + [cycle[0]]
+            legs = []
+            leg_lines = []
+            first_line = None
+            for a, b in zip(ring, ring[1:]):
+                line, fn_name = edges[(a, b)]
+                legs.append(f"{self._lock_name(a)} -> "
+                            f"{self._lock_name(b)} "
+                            f"(`{fn_name}` line {line})")
+                leg_lines.append(line)
+                if first_line is None or line < first_line:
+                    first_line = line
+            # The pragma is accepted on this cycle's OWN edge lines only:
+            # widening to every edge in the module would let one audited
+            # cycle silence an unrelated one.
+            self._emit(
+                "DP502", first_line or 1,
+                f"lock-acquisition-order cycle: {'; '.join(legs)} — two "
+                f"threads entering from opposite ends deadlock; impose "
+                f"one global acquisition order (or merge the locks)",
+                extra_lines=tuple(leg_lines),
+            )
+
+    def _resolve_local_call(self, call: ast.Call) -> ast.AST | None:
+        func = call.func
+        name = None
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and func.value.id == "self":
+            name = func.attr
+        elif isinstance(func, ast.Name):
+            name = func.id
+        return self._local_fns.get(name) if name else None
+
+    # -- DP503: divergent collective participation ----------------------
+
+    def _participation(self, stmts: Iterable[ast.AST],
+                       depth: int = 0) -> list[tuple[str, int]]:
+        """(callee-name, line) of every participation call in ``stmts``,
+        resolved one same-module call level down (attributed to the call
+        site's line)."""
+        out: list[tuple[str, int]] = []
+        for node in walk_skipping_defs(list(stmts)):
+            if not isinstance(node, ast.Call):
+                continue
+            name = last_segment(_dotted(node.func))
+            if _participation_family(name) is not None:
+                out.append((name, node.lineno))
+            elif depth == 0:
+                callee = self._resolve_local_call(node)
+                if callee is not None:
+                    out.extend(
+                        (n, node.lineno)
+                        for n, _ in self._participation(callee.body,
+                                                        depth=1)
+                    )
+        return out
+
+    @staticmethod
+    def _terminates(body: list[ast.AST]) -> bool:
+        """True when the branch SILENTLY diverts control past the rest
+        of the suite. A ``raise`` exit deliberately does not count: the
+        raising rank fails loudly and its peers' bounded awaits (DP402
+        guarantees the bound) surface a typed timeout — the designed
+        failure path, not the silent skip that wedged PR 14."""
+        if not body:
+            return False
+        last = body[-1]
+        if isinstance(last, (ast.Return, ast.Continue)):
+            return True
+        if isinstance(last, ast.Expr) and isinstance(last.value, ast.Call):
+            return last_segment(_dotted(last.value.func)) in ("exit",
+                                                              "_exit")
+        return False
+
+    def _suites(self, tree: ast.Module) -> list[list[ast.AST]]:
+        out = [tree.body]
+        for node in ast.walk(tree):
+            for field in ("body", "orelse", "finalbody"):
+                suite = getattr(node, field, None)
+                if isinstance(suite, list) and suite and \
+                        isinstance(suite[0], ast.stmt):
+                    out.append(suite)
+        return out
+
+    def _check_dp503(self, tree: ast.Module) -> None:
+        suites = self._suites(tree)
+        suite_of: dict[int, tuple[list[ast.AST], int]] = {}
+        for suite in suites:
+            for i, stmt in enumerate(suite):
+                suite_of[id(stmt)] = (suite, i)
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.If) or not _is_rank_gated(node.test):
+                continue
+            body_p = self._participation(node.body)
+            else_p = self._participation(node.orelse)
+            suite, idx = suite_of.get(id(node), (None, -1))
+            after_p: list[tuple[str, int]] = []
+            if suite is not None:
+                after_p = self._participation(suite[idx + 1:])
+
+            def matched(name: str, peers: list[tuple[str, int]],
+                        trailing: list[tuple[str, int]],
+                        has_peer_branch: bool) -> bool:
+                fam = _participation_family(name)
+                if name in _SYMMETRIC:
+                    # only the same collective on the peer BRANCH counts:
+                    # a second copy after the `if` means the gated ranks
+                    # run it twice — still divergent.
+                    return any(n == name for n, _ in peers)
+                pool = list(peers) + ([] if has_peer_branch else trailing)
+                return any(_participation_family(n) == fam
+                           for n, _ in pool)
+
+            for branch, peers in ((body_p, else_p), (else_p, body_p)):
+                has_peer = bool(node.orelse)
+                for name, line in branch:
+                    if name not in _BLOCKING_PARTICIPATION:
+                        continue
+                    if matched(name, peers, after_p, has_peer):
+                        continue
+                    self._emit(
+                        "DP503", line,
+                        f"`{name}` is dominated by the rank/leader-"
+                        f"dependent conditional at line {node.lineno} "
+                        f"with no matching participation on the peer "
+                        f"path — the excluded ranks never enter it and "
+                        f"the participants wedge waiting for them (the "
+                        f"PR 14 quiesce-gate bug, statically); make the "
+                        f"call unconditional, or give the peer branch "
+                        f"its matching side of the handshake",
+                        extra_lines=(line - 1, node.lineno),
+                    )
+
+            # rank-gated early exit: ranks excluded by the guard never
+            # reach a collective later in the same suite.
+            if not node.orelse and self._terminates(node.body) and \
+                    suite is not None:
+                for name, line in after_p:
+                    if name not in _BLOCKING_PARTICIPATION:
+                        continue
+                    self._emit(
+                        "DP503", line,
+                        f"`{name}` sits after the rank-gated early exit "
+                        f"at line {node.lineno}: the ranks that return "
+                        f"there never participate, so every other rank "
+                        f"wedges in the collective — hoist the exit "
+                        f"below the collective or drop the gate",
+                        extra_lines=(line - 1, node.lineno),
+                    )
+
+    # -- DP504: thread lifecycle ---------------------------------------
+
+    def _joined_handles(self, tree: ast.Module) -> set[str]:
+        joined: set[str] = set()
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"):
+                continue
+            base = node.func.value
+            if isinstance(base, ast.Name):
+                joined.add(base.id)
+            elif isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self":
+                joined.add(f"self.{base.attr}")
+        return joined
+
+    def _has_stop_flag(self, target: ast.AST) -> bool:
+        bodies = [target.body]
+        for node in walk_skipping_defs(target.body):
+            if isinstance(node, ast.Call):
+                callee = self._resolve_local_call(node)
+                if callee is not None and callee is not target:
+                    bodies.append(callee.body)
+        for body in bodies:
+            for node in walk_skipping_defs(body):
+                name = None
+                if isinstance(node, ast.Name):
+                    name = node.id
+                elif isinstance(node, ast.Attribute):
+                    name = node.attr
+                if name is not None and _STOPFLAG.search(name):
+                    return True
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "is_set":
+                    return True
+        return False
+
+    def _check_dp504(self, tree: ast.Module) -> None:
+        joined = self._joined_handles(tree)
+        for call, target_name, daemon, handle in self._threads:
+            if not daemon:
+                if handle is None or handle not in joined:
+                    where = (f"handle `{handle}` is never `.join()`-ed "
+                             f"in this module"
+                             if handle is not None else
+                             "the Thread object is not even stored")
+                    self._emit(
+                        "DP504", call.lineno,
+                        f"non-daemon thread created here but {where} — "
+                        f"an unjoined non-daemon thread keeps the "
+                        f"process alive past every drain/exit path; "
+                        f"join it on shutdown (or make it a daemon with "
+                        f"a stop flag)",
+                        extra_lines=(call.lineno - 1,),
+                    )
+                continue
+            target = self._local_fns.get(target_name or "")
+            if target is None:
+                continue
+            has_while = any(isinstance(n, ast.While)
+                            for n in walk_skipping_defs(target.body))
+            if has_while and not self._has_stop_flag(target):
+                self._emit(
+                    "DP504", call.lineno,
+                    f"daemon thread target `{target_name}` loops with no "
+                    f"stop flag in sight — the service loop cannot be "
+                    f"drained, so shutdown either leaks it mid-operation "
+                    f"or hangs; check a `threading.Event` (or a stop "
+                    f"attribute) every turn",
+                    extra_lines=(call.lineno - 1,),
+                )
+
+        # Condition.wait outside a predicate while: wait() must be re-
+        # checked in a loop — missed wakeups and spurious wakeups are
+        # both allowed by spec.
+        cond_names: set[str] = set(self._module_conds)
+        cond_attrs: set[str] = set()
+        for attrs in self._attr_conds.values():
+            cond_attrs |= attrs
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("wait", "wait_for")):
+                continue
+            base = node.func.value
+            is_cond = False
+            if isinstance(base, ast.Name):
+                is_cond = (base.id in cond_names
+                           or bool(_LOCKISH.search(base.id))
+                           and "cond" in base.id.lower())
+            elif isinstance(base, ast.Attribute):
+                is_cond = (base.attr in cond_attrs
+                           or "cond" in base.attr.lower())
+            if not is_cond or node.func.attr == "wait_for":
+                # wait_for carries its own predicate loop by contract
+                continue
+            cur = self._parents.get(id(node))
+            in_while = False
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if isinstance(cur, ast.While):
+                    in_while = True
+                    break
+                cur = self._parents.get(id(cur))
+            if not in_while:
+                self._emit(
+                    "DP504", node.lineno,
+                    f"`Condition.wait` outside a predicate `while` loop "
+                    f"— a missed wakeup blocks forever and a spurious "
+                    f"wakeup proceeds on a false predicate (both "
+                    f"permitted by spec); wrap it as "
+                    f"`while not <predicate>: cond.wait(...)`",
+                    extra_lines=(node.lineno - 1,),
+                )
+
+    # -- DP505: lock held across a blocking call ------------------------
+
+    def _blocking_what(self, call: ast.Call) -> str | None:
+        dotted = _dotted(call.func)
+        last = last_segment(dotted)
+        if last is None:
+            return None
+        if last == "sleep":
+            return "time.sleep"
+        if last in _SYMMETRIC:
+            return f"host collective `{last}`"
+        if last == "block_until_ready":
+            return "device sync `block_until_ready`"
+        if last in _SUBPROCESS_CALLS and dotted and (
+                dotted.startswith("subprocess.") or last == "communicate"):
+            return f"subprocess `{last}`"
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _DURABLE_WRITE_ATTRS:
+                return f"durable IO `.{func.attr}()`"
+            if func.attr in ("get", "acquire", "join") and \
+                    not call.args and not call.keywords:
+                return f"untimed `.{func.attr}()`"
+        return None
+
+    def _check_dp505(self, tree: ast.Module) -> None:
+        for fn in function_index(tree):
+            cls = self._cls_of.get(id(fn))
+            lock_of = self._lock_of(cls)
+            for node, held in _held_nodes(fn.body, frozenset(), lock_of):
+                if not held or not isinstance(node, ast.Call):
+                    continue
+                locks = sorted(self._lock_name(k) for k in held)
+                what = self._blocking_what(node)
+                via = ""
+                if what is None:
+                    callee = self._resolve_local_call(node)
+                    if callee is not None and callee is not fn:
+                        for sub in walk_skipping_defs(callee.body):
+                            if isinstance(sub, ast.Call):
+                                what = self._blocking_what(sub)
+                                if what is not None:
+                                    via = f" (via `{callee.name}`)"
+                                    break
+                if what is None:
+                    continue
+                self._emit(
+                    "DP505", node.lineno,
+                    f"{locks} held across blocking {what}{via} in "
+                    f"`{fn.name}` — every peer contending for the lock "
+                    f"stalls behind the slow operation (and a wedged "
+                    f"callee wedges the lock forever); move the blocking "
+                    f"call outside the critical section, or audit a "
+                    f"deliberate bracket with `# dplint: allow(DP505)`",
+                    extra_lines=(node.lineno - 1,),
+                )
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+
+
+def lint_source(path: str, source: str) -> list[Finding]:
+    return _ConcLinter(path, source).run()
+
+
+def lint_file(path: str) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(path, f.read())
+
+
+def lint_paths(paths: Iterable[str]) -> list[Finding]:
+    """The full Level-5 pass (per-file: no cross-file state here)."""
+    findings: list[Finding] = []
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8") as f:
+            findings.extend(lint_source(path, f.read()))
+    return findings

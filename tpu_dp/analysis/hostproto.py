@@ -73,6 +73,17 @@ from tpu_dp.analysis.astlint import (
     scope_at,
     scope_index,
 )
+from tpu_dp.analysis.callgraph import (
+    call_routers,
+    enclosing_function,
+    function_index,
+    in_scope,
+    last_segment,
+    local_callables,
+    pkg_rel,
+    routed_functions,
+    walk_skipping_defs,
+)
 from tpu_dp.analysis.report import Finding
 
 # --------------------------------------------------------------------------
@@ -99,21 +110,11 @@ _MACHINERY = ("resilience/retry.py", "resilience/faultinject.py",
               "chaos/storage.py")
 
 
-def _pkg_rel(path: str) -> str | None:
-    """Path relative to the ``tpu_dp`` package (posix), or None if outside."""
-    p = os.path.abspath(path).replace(os.sep, "/")
-    marker = "/tpu_dp/"
-    idx = p.rfind(marker)
-    if idx < 0:
-        return None
-    return p[idx + len(marker):]
-
-
-def _in_scope(path: str, prefixes: tuple[str, ...]) -> bool:
-    rel = _pkg_rel(path)
-    if rel is None:
-        return True  # fixtures / scratch copies: every rule applies
-    return rel.startswith(prefixes)
+# Scoping + one-level call-graph machinery lives in
+# `tpu_dp.analysis.callgraph` (shared with Level 5); the underscore
+# aliases keep this module's historical internal surface stable.
+_pkg_rel = pkg_rel
+_in_scope = in_scope
 
 
 def dp401_applies(path: str) -> bool:
@@ -141,8 +142,7 @@ _WALL_TIME_FUNCS = {"time", "time_ns"}
 _BLOCKING_SLEEP = {"sleep"}
 
 
-def _last(dotted: str | None) -> str | None:
-    return None if dotted is None else dotted.rsplit(".", 1)[-1]
+_last = last_segment
 
 
 def _time_aliases(tree: ast.Module) -> tuple[set[str], dict[str, str]]:
@@ -197,47 +197,9 @@ class _Clocks:
         return parts[-1] in ("now", "utcnow") and "datetime" in parts
 
 
-def _function_index(tree: ast.Module) -> list[ast.AST]:
-    return [n for n in ast.walk(tree)
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-
-
-def _enclosing_function(tree: ast.Module, node: ast.AST) -> ast.AST | None:
-    """Innermost def containing ``node`` (by position), or None (module).
-
-    ``node`` itself is excluded from the candidates: for a def node this
-    must return the def's PARENT function (a closure's own span contains
-    its ``def`` line, and answering "itself" made router resolution
-    check whether the router call sits inside the routed closure — it
-    never does, so pure retry-routing silently stopped matching).
-    """
-    best = None
-    best_span = None
-    line = node.lineno
-    end = getattr(node, "end_lineno", line) or line
-    for fn in _function_index(tree):
-        if fn is node:
-            continue
-        f_end = fn.end_lineno or fn.lineno
-        if fn.lineno <= line and end <= f_end:
-            span = f_end - fn.lineno
-            if best_span is None or span < best_span:
-                best, best_span = fn, span
-    return best
-
-
-def _walk_skipping_defs(nodes: Iterable[ast.AST]):
-    """Walk statements without descending into nested function bodies —
-    a closure defined inside a loop runs on its own schedule, not the
-    loop's, so its calls are not the loop's calls."""
-    stack = list(nodes)
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)):
-            continue
-        yield node
-        stack.extend(ast.iter_child_nodes(node))
+_function_index = function_index
+_enclosing_function = enclosing_function
+_walk_skipping_defs = walk_skipping_defs
 
 
 # --------------------------------------------------------------------------
@@ -294,56 +256,17 @@ class _HostLinter:
     def _retry_routers(self, tree: ast.Module) -> set[str]:
         """`retry_call` plus every local function whose body calls it —
         the one-level interprocedural discovery that recognizes
-        ``elastic._ledger_io`` and ``checkpoint._io_retry`` as routers."""
-        routers = {"retry_call"}
-        for fn in _function_index(tree):
-            for node in ast.walk(fn):
-                if isinstance(node, ast.Call) and \
-                        _last(_dotted(node.func)) == "retry_call":
-                    routers.add(fn.name)
-                    break
-        return routers
+        ``elastic._ledger_io`` and ``checkpoint._io_retry`` as routers
+        (shared machinery: `callgraph.call_routers`)."""
+        return call_routers(tree, {"retry_call"})
 
     def _routed_functions(self, tree: ast.Module,
                           routers: set[str]) -> set[int]:
         """Node ids of function defs passed by name into a retry-router
-        call. Resolution is scope-aware on purpose: two closures named
-        ``_write`` in different functions are different functions, and
-        `_io_retry(_write)` inside one must not launder the other — that
-        exact aliasing is how the unrouted latest-pointer publish in
-        `CheckpointManager.save` hid from the first draft of this rule.
-        """
-        defs_by_name: dict[str, list[ast.AST]] = {}
-        for fn in _function_index(tree):
-            defs_by_name.setdefault(fn.name, []).append(fn)
-
-        def _resolve(name: str, call: ast.Call, attr: bool) -> None:
-            for d in defs_by_name.get(name, ()):
-                if attr:
-                    # self._write / obj.method: dynamic dispatch — any
-                    # same-named def may be the target.
-                    routed.add(id(d))
-                    continue
-                parent = _enclosing_function(tree, d)
-                if parent is None:
-                    routed.add(id(d))  # module-level def, module-wide name
-                    continue
-                p_end = parent.end_lineno or parent.lineno
-                if parent.lineno <= call.lineno <= p_end:
-                    routed.add(id(d))  # closure referenced from its scope
-
-        routed: set[int] = set()
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            if _last(_dotted(node.func)) not in routers:
-                continue
-            for arg in node.args:
-                if isinstance(arg, ast.Name):
-                    _resolve(arg.id, node, attr=False)
-                elif isinstance(arg, ast.Attribute):
-                    _resolve(arg.attr, node, attr=True)
-        return routed
+        call, scope-aware (shared machinery: `callgraph.routed_functions`
+        — see there for why aliasing two closures with one name must not
+        launder either)."""
+        return routed_functions(tree, routers)
 
     @staticmethod
     def _consults_shim(fn: ast.AST | None) -> bool:
@@ -488,7 +411,7 @@ class _HostLinter:
         return False
 
     def _local_callables(self, tree: ast.Module) -> dict[str, ast.AST]:
-        return {fn.name: fn for fn in _function_index(tree)}
+        return local_callables(tree)
 
     def _check_dp402(self, tree: ast.Module) -> None:
         local_fns = self._local_callables(tree)

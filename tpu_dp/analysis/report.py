@@ -208,6 +208,40 @@ RULES: dict[str, tuple[str, str]] = {
         "obs.counters.METRICS/METRIC_FAMILIES lets an obsctl diff or "
         "watch signal reference a counter nothing publishes",
     ),
+    "DP501": (
+        "shared attribute written without its guarding lock",
+        "a self.attr write reachable from a threading.Thread target "
+        "while the attribute's other access sites hold a lock is a data "
+        "race: the guarded readers believe the lock excludes the "
+        "writer, and it does not",
+    ),
+    "DP502": (
+        "lock-acquisition-order cycle",
+        "with a: ... with b: in one method and with b: ... with a: in "
+        "another (resolved one call down) deadlocks two threads "
+        "entering from opposite ends — the static deadlock check",
+    ),
+    "DP503": (
+        "rank-gated collective/handshake participation divergence",
+        "a barrier/gather/ledger-handshake await dominated by a rank- "
+        "or leader-dependent conditional with no matching participation "
+        "on the peer path wedges the whole mesh — the PR 14 "
+        "quiesce-gate chaos bug, statically",
+    ),
+    "DP504": (
+        "thread lifecycle / condition-wait discipline",
+        "a non-daemon thread never joined (or a daemon service loop "
+        "with no stop flag) outlives every drain path, and a "
+        "Condition.wait outside a predicate while misses wakeups and "
+        "wakes spuriously — both permitted by spec",
+    ),
+    "DP505": (
+        "lock held across a blocking call in a hot path",
+        "durable IO, time.sleep, an untimed get/acquire/join, a "
+        "subprocess, or a collective inside a with-lock block in "
+        "serve/pipeline hot paths stalls every peer of the lock behind "
+        "the slow operation",
+    ),
 }
 
 

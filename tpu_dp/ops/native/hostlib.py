@@ -80,6 +80,10 @@ def _get() -> ctypes.CDLL | None:
             if lib is None:
                 # Cache missing OR unloadable (e.g. built on another host of
                 # an NFS home, glibc upgraded since): rebuild in place.
+                # Holding the module lock across the one-time compile is
+                # the point: a second caller must wait for THIS build, not
+                # race a duplicate compiler into the same cache path.
+                # dplint: allow(DP505) one-time build serializes callers
                 if not _build(cached):
                     _build_failed = True  # no compiler: available() -> False
                     return None

@@ -1349,6 +1349,12 @@ def request_join(
             # the next record forms (its content is re-read at the top of
             # the next attempt), rather than claiming over a live member.
             try:
+                # Joiner-side wait, outside the mesh: an outsider polling
+                # for the record the SURVIVORS will publish. There is no
+                # peer branch to mirror — the sid gate selects between
+                # "wait out the zombie fence" and "claim the seat", both
+                # single-process paths.
+                # dplint: allow(DP503) joiner-side await, no peer path
                 ledger.await_epoch(
                     cur.epoch + 1,
                     timeout_s=max(0.5, deadline - time.monotonic()),
@@ -1527,6 +1533,11 @@ def maybe_join(cfg) -> JoinOutcome | None:
     except OSError:
         host = ""
     try:
+        # The sid/membership gates above select whether THIS process is a
+        # joiner at all; a non-joiner returns to the classic bootstrap,
+        # it does not skip a collective its peers entered. The ledger
+        # waits inside request_join are the joiner's one-sided handshake.
+        # dplint: allow(DP503) joiner-selection gate, not a peer split
         record, token = request_join(gen_dir, int(sid), timeout_s=probe,
                                      host=host, alive_timeout_s=timeout)
     except ElasticError as e:

@@ -637,6 +637,12 @@ class ServeReplica:
             self._stats, out = self._program(batch.bucket)(
                 self._stats, self._state, dev_batch
             )
+            # The device sync IS the protected operation: the lock must
+            # span the donated buffer's consumption until `out` is
+            # materialized, or a concurrent device_stats() reads a
+            # consumed buffer. Holding it across the sync is the bracket,
+            # not an accident.
+            # dplint: allow(DP505) donated-buffer bracket spans the sync
             jax.block_until_ready(out)
         t2 = time.perf_counter()
         predictions = np.asarray(out["prediction"])
@@ -780,14 +786,24 @@ class ServeReplica:
             return {"served": 0, "class_counts": [], "unreadable": True}
 
     def snapshot(self) -> dict:
-        """Host-side replica facts for the cluster report."""
+        """Host-side replica facts for the cluster report.
+
+        `_lock` brackets exactly what it guards: the donated-stats
+        bookkeeping and the model-swap pair. `status`/`quarantined` are
+        GIL-atomic publishes their writers never lock — reading them
+        inside the bracket would claim an exclusion that does not exist
+        (DP501's mixed-discipline race, from the reader side).
+        """
         with self._lock:
-            return {
-                "status": self.status,
-                "batches": self._batch_index,
-                "bucket_counts": dict(sorted(self._bucket_counts.items())),
-                "quarantined": self.quarantined,
-                "model_version": self.model_version,
-                "retraces": self.retraces,
-                "devices": int(self.mesh.devices.size),
-            }
+            batches = self._batch_index
+            bucket_counts = dict(sorted(self._bucket_counts.items()))
+            model_version = self.model_version
+        return {
+            "status": self.status,
+            "batches": batches,
+            "bucket_counts": bucket_counts,
+            "quarantined": self.quarantined,
+            "model_version": model_version,
+            "retraces": self.retraces,
+            "devices": int(self.mesh.devices.size),
+        }
