@@ -425,9 +425,12 @@ def test_end_signals_ignore_departed_epochs(tmp_path):
 
 def test_metrics_tail_incremental(tmp_path):
     """The live-watch tail parses only appended bytes and defers a
-    partial trailing line to the next tick."""
+    partial trailing line to the next tick (shared `tpu_dp.obs.tail`
+    reader; obsctl's old private name must stay importable)."""
     from tpu_dp.obs.obsctl import _MetricsTail
+    from tpu_dp.obs.tail import JsonlTail
 
+    assert _MetricsTail is JsonlTail
     path = tmp_path / "metrics.jsonl"
     tail = _MetricsTail(path)
     assert tail.poll() == []  # absent file: no data, no error

@@ -1,0 +1,722 @@
+"""Fleet layer suite (`tpu_dp/obs/fleet.py` + `obsctl fleet`, ISSUE 20).
+
+Three layers of evidence: units for the shared tail reader and the
+threaded stream tailer; alignment/derivation units for the aggregator
+(newest-attempt-wins across guard-rollback generations AND elastic
+membership epochs — no stale-world skew), the anomaly-rule window math,
+and the publish/read schema contract; then CLI acceptance — a synthetic
+straggler run where `obsctl fleet --replay` must exit 1 naming the
+injected rank under both rule grammars while the clean twin exits 0,
+the live tailing path over a growing run, and a 3-OS-process smoke
+driving the real `TPU_DP_FAULT` delay injector through real heartbeat
+writers across a process boundary.
+"""
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_dp.obs import obsctl
+from tpu_dp.obs.counters import Counters
+from tpu_dp.obs.fleet import (
+    FLEET_SCHEMA,
+    FleetAggregator,
+    FleetPublisher,
+    FleetSchemaError,
+    discover_streams,
+    fleet_signals,
+    read_fleet_records,
+    summarize,
+)
+from tpu_dp.obs.tail import JsonlTail, StreamTailer, read_jsonl
+
+pytestmark = pytest.mark.fleet
+
+
+# -- synthetic heartbeat trees ----------------------------------------------
+
+BASE_MS = 5.0
+#: the injected straggler: rank 2 stalls 300ms at steps 14/16/18 —
+#: a ~60x leave-one-out ratio against the ~5ms healthy median.
+DELAYS = {(14, 2): 300.0, (16, 2): 300.0, (18, 2): 300.0}
+
+
+def _write_beats(obs_dir: Path, world: int = 3, steps: int = 20,
+                 delays: dict | None = None, gen: int = 0,
+                 me_stamp: int = 0, start: int = 0) -> None:
+    """Per-rank heartbeat files with cumulative per-rank wall clocks:
+    rank r's step takes BASE_MS + r*0.1 ms (+ any injected delay), so
+    skew/ratio/slowest attribution are all exactly computable."""
+    delays = delays or {}
+    obs_dir.mkdir(parents=True, exist_ok=True)
+    for rank in range(world):
+        t = 1000.0
+        lines = []
+        for step in range(start, start + steps):
+            ms = BASE_MS + rank * 0.1 + delays.get((step, rank), 0.0)
+            t += ms / 1e3
+            rec = {"rank": rank, "step": step, "ts": round(t, 6),
+                   "step_ms": round(ms, 3)}
+            if gen:
+                rec["gen"] = gen
+            if me_stamp:
+                rec["me"] = me_stamp
+            lines.append(json.dumps(rec))
+        (obs_dir / f"heartbeat_r{rank:05d}.jsonl").write_text(
+            "\n".join(lines) + "\n")
+
+
+def _beat(rank, step, ts, step_ms, gen=None, me=None):
+    rec = {"rank": rank, "step": step, "ts": ts, "step_ms": step_ms}
+    if gen is not None:
+        rec["gen"] = gen
+    if me is not None:
+        rec["me"] = me
+    return rec
+
+
+@pytest.fixture
+def faulty_run(tmp_path):
+    run = tmp_path / "faulty"
+    _write_beats(run / "obs", delays=DELAYS)
+    return run
+
+
+@pytest.fixture
+def clean_run(tmp_path):
+    run = tmp_path / "clean"
+    _write_beats(run / "obs")
+    return run
+
+
+# -- JsonlTail: the shared byte-offset reader -------------------------------
+
+def test_tail_partial_trailing_line_deferred(tmp_path):
+    p = tmp_path / "s.jsonl"
+    p.write_text('{"a": 1}\n{"a": 2')  # writer mid-append
+    tail = JsonlTail(p)
+    assert tail.poll() == [{"a": 1}]
+    assert tail.poll() == []           # the torn half stays unread
+    with open(p, "a") as f:
+        f.write('2}\n{"a": 3}\n')
+    assert tail.poll() == [{"a": 22}, {"a": 3}]
+
+
+def test_tail_truncation_resets_to_top(tmp_path):
+    p = tmp_path / "s.jsonl"
+    p.write_text('{"a": 1}\n{"a": 2}\n')
+    tail = JsonlTail(p)
+    assert len(tail.poll()) == 2
+    p.write_text('{"b": 9}\n')         # rotate/truncate: smaller file
+    assert tail.poll() == [{"b": 9}]   # offset reset, not EOF garbage
+
+
+def test_tail_garbage_lines_skipped_and_missing_file(tmp_path):
+    p = tmp_path / "s.jsonl"
+    assert JsonlTail(p).poll() == []   # not yet created: no error
+    p.write_text('{"a": 1}\nnot json\n[1, 2]\n{"a": 2}\n')
+    # torn/garbage and non-dict lines skipped, offset still advances
+    tail = JsonlTail(p)
+    assert tail.poll() == [{"a": 1}, {"a": 2}]
+    assert tail.poll() == []
+    assert read_jsonl(p) == [{"a": 1}, {"a": 2}]
+
+
+# -- StreamTailer: N streams, one poll thread -------------------------------
+
+def test_stream_tailer_add_idempotent_meta_threading(tmp_path):
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    a.write_text('{"x": 1}\n')
+    b.write_text('{"y": 1}\n')
+    tailer = StreamTailer()
+    assert tailer.add(a, ("hb", 0)) is True
+    assert tailer.add(a, ("hb", 0)) is False   # already registered
+    assert tailer.add(b, ("hb", 1)) is True
+    assert sorted(tailer.paths) == sorted([a, b])
+    assert tailer.poll_once() == 2
+    got = tailer.drain()
+    assert (("hb", 0), {"x": 1}) in got and (("hb", 1), {"y": 1}) in got
+    assert tailer.drain() == []                # drained means drained
+
+
+def test_stream_tailer_bounded_buffer_drops_oldest(tmp_path):
+    p = tmp_path / "s.jsonl"
+    p.write_text("".join(json.dumps({"i": i}) + "\n" for i in range(10)))
+    tailer = StreamTailer(max_buffer=4)
+    tailer.add(p)
+    tailer.poll_once()
+    assert tailer.dropped == 6
+    got = [rec["i"] for _, rec in tailer.drain()]
+    assert got == [6, 7, 8, 9]                 # newest survive
+
+
+def test_stream_tailer_thread_lifecycle(tmp_path):
+    p = tmp_path / "s.jsonl"
+    p.write_text('{"i": 0}\n')
+    with StreamTailer(interval_s=0.05) as tailer:
+        tailer.add(p)
+        with open(p, "a") as f:
+            f.write('{"i": 1}\n')
+        deadline = time.monotonic() + 5.0
+        seen = []
+        while len(seen) < 2 and time.monotonic() < deadline:
+            seen.extend(rec["i"] for _, rec in tailer.drain())
+            time.sleep(0.02)
+        assert seen == [0, 1]
+    # context exit joined the thread; stop() again is a no-op
+    assert tailer._thread is None
+    tailer.stop()
+    assert not any(t.name == "obs-stream-tailer"
+                   for t in threading.enumerate())
+
+
+# -- stream discovery -------------------------------------------------------
+
+def test_discover_streams_full_tree(tmp_path):
+    run = tmp_path / "run"
+    (run / "obs" / "me0001").mkdir(parents=True)
+    (run / "metrics.jsonl").write_text("{}\n")
+    (run / "obs" / "heartbeat_r00000.jsonl").write_text("{}\n")
+    (run / "obs" / "me0001" / "heartbeat_r00001.jsonl").write_text("{}\n")
+    (run / "obs" / "replica_r00000.jsonl").write_text("{}\n")
+    (run / "obs" / "serve_router.jsonl").write_text("{}\n")
+    got = {(kind, tuple(sorted(meta.items())))
+           for kind, meta, _ in discover_streams(run)}
+    assert got == {
+        ("metrics", ()),
+        ("heartbeat", (("me", 0), ("rank", 0))),
+        ("heartbeat", (("me", 1), ("rank", 1))),
+        ("replica", (("sid", 0),)),
+        ("router", ()),
+    }
+
+
+def test_discover_streams_bare_heartbeat_tree(tmp_path):
+    # a HeartbeatWriter-only dir (no obs/ nesting) still discovers
+    _write_beats(tmp_path, world=2, steps=1)
+    kinds = [(k, m.get("rank")) for k, m, _ in discover_streams(tmp_path)]
+    assert kinds == [("heartbeat", 0), ("heartbeat", 1)]
+
+
+# -- aggregation: alignment + derivation ------------------------------------
+
+def test_emits_only_once_expected_world_reported():
+    agg = FleetAggregator("/nonexistent")
+    for rank in range(3):
+        agg.note_stream("heartbeat", {"me": 0, "rank": rank})
+    # two of three known ranks in: no emission — a step published with a
+    # not-yet-read rank missing would mis-attribute the skew
+    assert agg.ingest("heartbeat", {"me": 0}, _beat(0, 0, 10.0, 5.0)) == []
+    assert agg.ingest("heartbeat", {"me": 0}, _beat(1, 0, 10.001, 5.0)) == []
+    recs = agg.ingest("heartbeat", {"me": 0}, _beat(2, 0, 10.295, 300.0))
+    assert len(recs) == 1 and recs[0]["world"] == 3
+
+
+def test_skew_math_and_attribution():
+    agg = FleetAggregator("/nonexistent", expected_world=3)
+    agg.ingest("heartbeat", {"me": 0}, _beat(0, 7, 10.0, 5.0))
+    agg.ingest("heartbeat", {"me": 0}, _beat(1, 7, 10.001, 5.0))
+    (rec,) = agg.ingest("heartbeat", {"me": 0}, _beat(2, 7, 10.295, 300.0))
+    assert rec["kind"] == "fleet_step" and rec["schema"] == FLEET_SCHEMA
+    assert rec["step"] == 7 and rec["ranks"] == [0, 1, 2]
+    assert rec["step_skew_ms"] == pytest.approx(295.0, abs=0.01)
+    assert rec["slowest_rank"] == 2
+    assert rec["median_other_ms"] == 5.0       # leave-one-out median
+    assert rec["skew_ratio"] == pytest.approx(60.0)
+    assert rec["step_time_ms"] == 300.0        # fleet clock = slowest
+    assert rec["spike"] is True                # 60 >= default 3.0
+    assert rec["ts"] == 10.295                 # last arrival
+
+
+def test_min_step_ms_floor_suppresses_jitter_ratios():
+    # µs-scale steps: 0.5ms over a 0.001ms median would read as 500x —
+    # the floor (same as HealthMonitor's) keeps jitter out of the pager
+    agg = FleetAggregator("/nonexistent", expected_world=2, min_step_ms=1.0)
+    agg.ingest("heartbeat", {"me": 0}, _beat(0, 0, 10.0, 0.001))
+    (rec,) = agg.ingest("heartbeat", {"me": 0}, _beat(1, 0, 10.0, 0.5))
+    assert rec["skew_ratio"] == pytest.approx(0.5)
+    assert rec["spike"] is False
+
+
+def test_slowest_streak_persistence():
+    agg = FleetAggregator("/nonexistent", expected_world=2)
+    streaks = []
+    slow = [1, 1, 1, 0]                        # rank 1 thrice, then rank 0
+    for step, victim in enumerate(slow):
+        agg.ingest("heartbeat", {"me": 0},
+                   _beat(1 - victim, step, 10.0 + step, 5.0))
+        (rec,) = agg.ingest("heartbeat", {"me": 0},
+                            _beat(victim, step, 10.0 + step, 50.0))
+        streaks.append((rec["slowest_rank"], rec["slowest_streak"]))
+    assert streaks == [(1, 1), (1, 2), (1, 3), (0, 1)]
+
+
+def test_rollback_generation_newest_attempt_wins():
+    agg = FleetAggregator("/nonexistent", expected_world=2)
+    # gen-0 attempt at step 6 emits…
+    agg.ingest("heartbeat", {"me": 0}, _beat(0, 6, 10.0, 5.0))
+    (first,) = agg.ingest("heartbeat", {"me": 0}, _beat(1, 6, 10.0, 5.0))
+    assert first["gen"] == 0
+    # …the replay attempt (gen 1, post-rollback) supersedes it…
+    agg.ingest("heartbeat", {"me": 0}, _beat(0, 6, 20.0, 5.0, gen=1))
+    (replay,) = agg.ingest("heartbeat", {"me": 0},
+                           _beat(1, 6, 20.0, 5.0, gen=1))
+    assert replay["gen"] == 1
+    # …and a STALE gen-0 straggler completing late must never emit over
+    # the newer attempt (no stale-world skew)
+    agg2 = FleetAggregator("/nonexistent", expected_world=2)
+    agg2.ingest("heartbeat", {"me": 0}, _beat(0, 6, 20.0, 5.0, gen=1))
+    agg2.ingest("heartbeat", {"me": 0}, _beat(1, 6, 20.0, 5.0, gen=1))
+    agg2.ingest("heartbeat", {"me": 0}, _beat(0, 6, 10.0, 5.0))
+    assert agg2.ingest("heartbeat", {"me": 0}, _beat(1, 6, 99.0, 5.0)) == []
+    assert agg2.flush() == []                  # and not resurrected later
+
+
+def test_elastic_regroup_no_stale_world_skew(tmp_path):
+    """A 3-rank epoch-0 world re-homes to a 2-rank me0001/ world across
+    steps 4..9; the me-1 records must align only among themselves (world
+    2) and win the overlap steps, and a stale epoch-0 group arriving
+    after the epoch-1 emission must be dropped."""
+    run = tmp_path / "run"
+    _write_beats(run / "obs", world=3, steps=6)                 # steps 0..5
+    _write_beats(run / "obs" / "me0001", world=2, steps=6,
+                 me_stamp=1, start=4)                           # steps 4..9
+    recs = FleetAggregator(run).replay()
+    by_step: dict[int, dict] = {}
+    for r in recs:                             # newest attempt wins
+        cur = by_step.get(r["step"])
+        if cur is None or (r["me"], r["gen"]) > (cur["me"], cur["gen"]):
+            by_step[r["step"]] = r
+    # overlap steps surface the NEW world's alignment, never a mixed one
+    for step in (4, 5):
+        assert by_step[step]["me"] == 1
+        assert by_step[step]["world"] == 2
+        assert by_step[step]["ranks"] == [0, 1]
+    for step in (0, 1, 2, 3):
+        assert by_step[step]["me"] == 0 and by_step[step]["world"] == 3
+    assert all(by_step[s]["me"] == 1 for s in range(6, 10))
+    # direct ingest order-invariance: epoch-1 emitted first, the full
+    # stale epoch-0 group completing afterwards must not emit
+    agg = FleetAggregator("/nonexistent")
+    agg.note_stream("heartbeat", {"me": 1, "rank": 0})
+    agg.note_stream("heartbeat", {"me": 1, "rank": 1})
+    for rank in range(3):
+        agg.note_stream("heartbeat", {"me": 0, "rank": rank})
+    agg.ingest("heartbeat", {"me": 1}, _beat(0, 4, 30.0, 5.0, me=1))
+    assert agg.ingest("heartbeat", {"me": 1},
+                      _beat(1, 4, 30.0, 5.0, me=1)) != []
+    agg.ingest("heartbeat", {"me": 0}, _beat(0, 4, 10.0, 5.0))
+    agg.ingest("heartbeat", {"me": 0}, _beat(1, 4, 10.0, 5.0))
+    assert agg.ingest("heartbeat", {"me": 0},
+                      _beat(2, 4, 25.0, 5.0)) == []
+
+
+def test_flush_emits_best_remaining_attempt_only():
+    agg = FleetAggregator("/nonexistent", expected_world=3)
+    agg.ingest("heartbeat", {"me": 0}, _beat(0, 3, 10.0, 5.0))
+    agg.ingest("heartbeat", {"me": 0}, _beat(1, 3, 10.0, 5.0))  # 2 of 3
+    agg.ingest("heartbeat", {"me": 0}, _beat(0, 4, 11.0, 5.0))  # 1 of 3
+    out = agg.flush()
+    assert [r["step"] for r in out] == [3]     # a lone rank has no median
+    assert out[0]["world"] == 2
+
+
+def test_replay_attributes_injected_straggler(faulty_run, clean_run):
+    recs = FleetAggregator(faulty_run).replay()
+    steps = [r for r in recs if r["kind"] == "fleet_step"]
+    assert len(steps) == 20
+    spikes = [r for r in steps if r["spike"]]
+    assert [r["step"] for r in spikes] == [14, 16, 18]
+    assert all(r["slowest_rank"] == 2 for r in spikes)
+    assert all(r["skew_ratio"] > 50 for r in spikes)
+    rep = summarize(recs)
+    assert rep["slowest_rank"] == 2 and rep["spikes"] == 3
+    assert rep["max_skew_step"] in (14, 16, 18)
+    clean = summarize(FleetAggregator(clean_run).replay())
+    assert clean["spikes"] == 0 and clean["max_skew_ratio"] < 1.5
+
+
+def test_metrics_gauges_ride_along():
+    agg = FleetAggregator("/nonexistent", expected_world=2)
+    agg.ingest("heartbeat", {"me": 0}, _beat(0, 0, 10.0, 5.0))
+    (bare,) = agg.ingest("heartbeat", {"me": 0}, _beat(1, 0, 10.0, 5.0))
+    assert "mfu" not in bare and "goodput" not in bare   # never fabricated
+    agg.ingest("metrics", {}, {"mfu": 0.41,
+                               "counters": {"obs.goodput": 0.87}})
+    agg.ingest("heartbeat", {"me": 0}, _beat(0, 1, 11.0, 5.0))
+    (rec,) = agg.ingest("heartbeat", {"me": 0}, _beat(1, 1, 11.0, 5.0))
+    assert rec["mfu"] == 0.41 and rec["goodput"] == 0.87
+    assert fleet_signals(rec)["fleet.mfu"] == 0.41
+
+
+def test_serve_rollup_worst_class_attainment():
+    agg = FleetAggregator("/nonexistent")
+    agg.ingest("replica", {"sid": 0}, {"kind": "replica", "status": "live"})
+    agg.ingest("replica", {"sid": 1},
+               {"kind": "replica", "status": "quarantined"})
+    (rec,) = agg.ingest("router", {}, {
+        "kind": "router", "ts": 50.0, "queue_depth": 7, "replicas_live": 1,
+        "classes": {"0": {"attainment": 0.95}, "1": {"attainment": 0.7}},
+    })
+    assert rec["kind"] == "fleet_serve" and rec["queue_depth"] == 7
+    assert rec["attainment"] == 0.7            # worst class, not average
+    assert rec["replica_status"] == {"live": 1, "quarantined": 1}
+    sig = fleet_signals(rec)
+    assert sig == {"fleet.queue_depth": 7.0, "fleet.attainment": 0.7}
+
+
+# -- publication + schema contract ------------------------------------------
+
+def test_publisher_stream_gauges_and_promfile(tmp_path, faulty_run):
+    out, prom = tmp_path / "fleet.jsonl", tmp_path / "fleet.prom"
+    reg = Counters()
+    pub = FleetPublisher(out, prom_path=prom, registry=reg)
+    recs = FleetAggregator(faulty_run).replay()
+    pub.publish(recs)
+    assert pub.published == len(recs)
+    assert read_fleet_records(out) == recs     # schema-stamped round trip
+    snap = reg.snapshot()
+    assert snap["fleet.slowest_rank"] == 2.0
+    assert snap["fleet.skew_ratio"] == recs[-1]["skew_ratio"]  # last write
+    assert snap["fleet.step_time_p95_ms"] > 100   # window holds the spikes
+    assert prom.exists() and "fleet" in prom.read_text()
+
+
+def test_publisher_swallows_failures_into_counter(tmp_path):
+    (tmp_path / "blocked").write_text("a file, not a directory")
+    reg = Counters()
+    pub = FleetPublisher(tmp_path / "blocked" / "fleet.jsonl", registry=reg)
+    rec = {"schema": FLEET_SCHEMA, "kind": "fleet_step", "ts": 1.0,
+           "step": 0, "slowest_rank": 0, "skew_ratio": 1.0}
+    pub.publish([rec])                         # must not raise
+    assert reg.get("fleet.publish_errors") == 1
+    assert pub.published == 0
+
+
+def test_unknown_schema_is_refused_strict_but_skipped_forensic(
+        tmp_path, capsys):
+    p = tmp_path / "obs" / "fleet.jsonl"
+    p.parent.mkdir(parents=True)
+    good = {"schema": FLEET_SCHEMA, "kind": "fleet_step", "ts": 1.0,
+            "step": 0, "slowest_rank": 0}
+    alien = {"schema": "tpu_dp.obs/fleet/v999", "kind": "fleet_step"}
+    p.write_text(json.dumps(good) + "\n" + json.dumps(alien) + "\n")
+    with pytest.raises(FleetSchemaError, match="v999"):
+        read_fleet_records(p)                  # strict consumer: refuse
+    art = obsctl.RunArtifacts(tmp_path)
+    # forensic reader: skips ONLY the alien record, keeps the readable one
+    assert art.fleet_records() == [good]
+    assert "unknown schema" in capsys.readouterr().err   # …and says so
+
+
+# -- watch grammar: fleet signals + anomaly rules ---------------------------
+
+def test_fleet_signals_are_first_class_rule_targets():
+    r = obsctl.WatchRule("fleet.skew_ratio>1.5")
+    assert (r.kind, r.signal, r.op, r.const) == (
+        "threshold", "fleet.skew_ratio", ">", 1.5)
+    assert obsctl.WatchRule("fleet.queue_depth>=10").signal == \
+        "fleet.queue_depth"
+    with pytest.raises(ValueError, match="unknown signal"):
+        obsctl.WatchRule("fleet.bogus>1")
+
+
+def test_anomaly_rule_parsing():
+    r = obsctl.WatchRule("anomaly:step_time_ms 4")
+    assert (r.kind, r.signal, r.deviations) == ("anomaly", "step_time_ms",
+                                                4.0)
+    assert obsctl.WatchRule("anomaly:fleet.skew_ratio 2.5").deviations == 2.5
+    for bad in ("anomaly:step_time_ms",        # no K
+                "anomaly:step_time_ms 0",      # zero deviations
+                "anomaly:step_time_ms -3",     # negative
+                "anomaly:nope 4"):             # unknown signal
+        with pytest.raises(ValueError):
+            obsctl.WatchRule(bad)
+
+
+def _feed(engine, values):
+    for i, v in enumerate(values):
+        engine.observe_record({"kind": "fleet_step", "schema": FLEET_SCHEMA,
+                               "step": i, "ts": float(i),
+                               "step_time_ms": float(v)})
+
+
+def test_anomaly_needs_min_history_before_scoring():
+    eng = obsctl.WatchEngine([obsctl.WatchRule("anomaly:step_time_ms 4")],
+                             None)
+    # a spike before ANOMALY_MIN_POINTS of history never scores — and the
+    # rule counts as never-evaluated (the exit-2 refuse-to-certify path)
+    _feed(eng, [100.0] * (eng.ANOMALY_MIN_POINTS - 1) + [1000.0])
+    assert eng.alerts == [] and eng.evaluated == set()
+    _feed(eng, [100.0])                        # window now at min points
+    assert eng.evaluated and eng.alerts == []
+
+
+def test_anomaly_trips_at_k_robust_deviations_not_below():
+    # 12 identical points: MAD 0, so sigma = REL_FLOOR * |median| = 5.0;
+    # K=4 puts the bound exactly at 100 ± 20
+    eng = obsctl.WatchEngine([obsctl.WatchRule("anomaly:step_time_ms 4")],
+                             None)
+    _feed(eng, [100.0] * 12)
+    _feed(eng, [119.0])                        # score 3.8 < 4
+    assert eng.alerts == []
+    _feed(eng, [121.0])                        # score 4.2 > 4
+    assert len(eng.alerts) == 1
+    ev = eng.alerts[0]
+    assert ev["signal"] == "step_time_ms" and ev["value"] == 121.0
+    assert ev["score"] == pytest.approx(4.2)
+    assert ev["median"] == 100.0 and ev["bound"] == pytest.approx(120.0)
+
+
+def test_anomaly_spike_does_not_baseline_itself():
+    eng = obsctl.WatchEngine([obsctl.WatchRule("anomaly:step_time_ms 4")],
+                             None)
+    _feed(eng, [100.0] * 12)
+    _feed(eng, [300.0])                        # scored BEFORE joining
+    _feed(eng, [300.0])                        # the window: still vs ~100
+    assert len(eng.alerts) == 2
+    _feed(eng, [100.0])                        # back to normal: no trip
+    assert len(eng.alerts) == 2
+
+
+# -- profile-derived rules (obsctl watch --profile) -------------------------
+
+def _tuned(tmp_path, claims):
+    from tpu_dp.tune.profile import build_profile, dump_profile, make_key
+
+    path = tmp_path / "tuned.json"
+    dump_profile(build_profile(
+        key=make_key("resnet18", 8, "cpu"), knobs={}, claims=claims,
+        objective={"metric": "img_per_sec_per_chip", "value": 123.0},
+        provenance={"seed": 1}), path)
+    return path
+
+
+def test_profile_rules_derivation(tmp_path):
+    path = _tuned(tmp_path, {
+        "mfu": 0.5, "goodput": 0.9, "overlap_frac": 0.8,
+        "comm_ms": 10.0, "exposed_comm_ms": 2.0, "p95_ms": 50.0,
+        "img_per_sec_per_chip": 123.0,
+    })
+    texts = {r.text for r in obsctl.profile_rules(path, tolerance=0.2)}
+    assert texts == {
+        "mfu<0.4", "goodput<0.72", "overlap_frac<0.64",
+        "comm_ms>12.0", "exposed_comm_ms>2.4", "step_time_ms>60.0",
+    }
+    # throughput has no stream twin: deliberately derives NO rule
+    assert not any("img_per_sec" in t for t in texts)
+
+
+def test_watch_profile_flag_end_to_end(tmp_path, capsys):
+    path = _tuned(tmp_path, {"mfu": 0.5})
+    run = tmp_path / "run"
+    run.mkdir()
+    (run / "metrics.jsonl").write_text("".join(
+        json.dumps({"step": i, "ts": float(i), "mfu": 0.1}) + "\n"
+        for i in range(3)))
+    assert obsctl.main(["watch", str(run), "--replay",
+                        "--profile", str(path)]) == 1   # claim violated
+    capsys.readouterr()
+    (run / "metrics.jsonl").write_text(
+        json.dumps({"step": 0, "ts": 0.0, "mfu": 0.5}) + "\n")
+    assert obsctl.main(["watch", str(run), "--replay",
+                        "--profile", str(path)]) == 0
+    capsys.readouterr()
+    bad = tmp_path / "not_a_profile.json"
+    bad.write_text('{"schema": "something/else"}')
+    assert obsctl.main(["watch", str(run), "--replay",
+                        "--profile", str(bad)]) == 2    # typed refusal
+    assert "schema" in capsys.readouterr().err
+
+
+# -- obsctl fleet CLI -------------------------------------------------------
+
+def test_cmd_fleet_replay_names_injected_rank(faulty_run, clean_run,
+                                              tmp_path, capsys):
+    """The CI gate in both directions: the straggler run exits 1 with BOTH
+    rule grammars tripping and the report naming the injected rank; the
+    clean twin — same rules, same thresholds — exits 0."""
+    report = tmp_path / "fleet_report.json"
+    rc = obsctl.main(["fleet", str(faulty_run), "--replay", "--json",
+                      "--rule", "fleet.skew_ratio>3",
+                      "--rule", "anomaly:step_time_ms 4",
+                      "--report", str(report)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["report"]["slowest_rank"] == 2
+    assert out["report"]["spikes"] == 3
+    tripped = {ev["rule"] for ev in out["alerts"]}
+    assert tripped == {"fleet.skew_ratio>3", "anomaly:step_time_ms 4"}
+    assert sorted(out["evaluated"]) == sorted(tripped)
+    # the published stream + the archived report are both readable
+    assert json.loads(report.read_text())["slowest_rank"] == 2
+    published = read_fleet_records(faulty_run / "obs" / "fleet.jsonl")
+    assert len(published) == out["published"] == 20
+
+    rc = obsctl.main(["fleet", str(clean_run), "--replay", "--json",
+                      "--rule", "fleet.skew_ratio>3",
+                      "--rule", "anomaly:step_time_ms 4"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["alerts"] == [] and len(out["evaluated"]) == 2
+
+
+def test_cmd_fleet_exit_codes_on_degenerate_input(tmp_path, capsys):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert obsctl.main(["fleet", str(empty), "--replay"]) == 2  # no streams
+    capsys.readouterr()
+    assert obsctl.main(["fleet", str(empty), "--replay",
+                        "--rule", "fleet.bogus>1"]) == 2        # bad rule
+    assert "unknown signal" in capsys.readouterr().err
+
+
+def test_watch_fleet_rule_aggregates_from_raw_artifacts(faulty_run,
+                                                        clean_run, capsys):
+    # no published fleet.jsonl: watch --replay must derive the fleet
+    # stream from the heartbeats itself
+    assert obsctl.main(["watch", str(faulty_run), "--replay",
+                        "--rule", "fleet.skew_ratio>3"]) == 1
+    capsys.readouterr()
+    assert obsctl.main(["watch", str(clean_run), "--replay",
+                        "--rule", "fleet.skew_ratio>3"]) == 0
+    capsys.readouterr()
+
+
+def test_timeline_markers_and_trace_counter_track(faulty_run, tmp_path,
+                                                  capsys):
+    # publish the fleet stream, then the forensic surfaces must carry it
+    assert obsctl.main(["fleet", str(faulty_run), "--replay"]) == 0
+    capsys.readouterr()
+    rc = obsctl.main(["timeline", str(faulty_run), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    marks = [e for e in out["events"] if e["kind"] == "fleet_skew"]
+    assert [e["step"] for e in marks] == [14, 16, 18]
+    assert all(e["rank"] == 2 for e in marks)
+    assert all(e["detail"]["skew_ratio"] > 50 for e in marks)
+    assert out["stats"]["sources"]["fleet"] is True
+
+    trace_path = tmp_path / "merged.json"
+    assert obsctl.main(["merge-trace", str(faulty_run), "-o",
+                        str(trace_path)]) == 0
+    trace = json.loads(trace_path.read_text())
+    counters = [e for e in trace["traceEvents"]
+                if e.get("ph") == "C" and e["name"] == "fleet.step_skew_ms"]
+    assert len(counters) == 20
+    assert all(e["pid"] == 999_000 for e in counters)
+    procs = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert "fleet" in procs
+    assert any(e.get("ph") == "i" and e["name"] == "fleet_skew"
+               for e in trace["traceEvents"])
+
+
+def test_cmd_fleet_live_tails_growing_run(tmp_path, capsys):
+    """The live path: ranks append heartbeats WHILE `obsctl fleet` tails —
+    the injected stall must trip both rule grammars live."""
+    run = tmp_path / "run"
+    obs = run / "obs"
+    obs.mkdir(parents=True)
+
+    def writer():
+        files = [open(obs / f"heartbeat_r{r:05d}.jsonl", "a")
+                 for r in range(3)]
+        t = [1000.0] * 3
+        try:
+            for step in range(15):
+                for r, f in enumerate(files):
+                    ms = BASE_MS + r * 0.1 + (300.0 if (step, r) == (10, 2)
+                                              else 0.0)
+                    t[r] += ms / 1e3
+                    f.write(json.dumps({"rank": r, "step": step,
+                                        "ts": t[r], "step_ms": ms}) + "\n")
+                    f.flush()
+                time.sleep(0.05)
+        finally:
+            for f in files:
+                f.close()
+
+    th = threading.Thread(target=writer)
+    th.start()
+    try:
+        rc = obsctl.main(["fleet", str(run), "--json",
+                          "--for-s", "3.0", "--interval", "0.2",
+                          "--rule", "fleet.skew_ratio>3",
+                          "--rule", "anomaly:step_time_ms 4"])
+    finally:
+        th.join()
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["report"]["steps"] == 15
+    assert out["report"]["slowest_rank"] == 2
+    assert out["report"]["max_skew_step"] == 10
+    assert {ev["rule"] for ev in out["alerts"]} == {
+        "fleet.skew_ratio>3", "anomaly:step_time_ms 4"}
+
+
+# -- 3-OS-process smoke: the real injector across a process boundary --------
+
+_FLEET_WORKER = r"""
+import sys, time
+rank = int(sys.argv[1]); run_dir = sys.argv[2]; spec = sys.argv[3]
+from tpu_dp.obs.health import HeartbeatWriter
+from tpu_dp.resilience.faultinject import FaultInjector
+
+inj = FaultInjector.from_spec(spec, rank=rank) if spec != "-" else None
+with HeartbeatWriter(run_dir, rank=rank) as hb:
+    for step in range(1, 13):
+        t0 = time.perf_counter()
+        time.sleep(0.02)               # uniform simulated step work
+        if inj is not None:
+            inj.on_step(step)          # the injected straggler stall
+        hb.beat(step, (time.perf_counter() - t0) * 1e3)
+print("FLEET_OK", rank, flush=True)
+"""
+
+
+def test_three_process_delay_fault_fleet_attribution(tmp_path, monkeypatch,
+                                                     capsys):
+    """End-to-end across real process boundaries: three OS processes
+    heartbeat through the production writer, the production TPU_DP_FAULT
+    delay injector stalls rank 2 at step 10, and `obsctl fleet --replay`
+    must exit 1 naming exactly that rank — while the clean twin, same
+    rules, exits 0."""
+    from test_multiprocess import _spawn_workers
+
+    monkeypatch.delenv("TPU_DP_FAULT", raising=False)
+    faulty, clean = tmp_path / "faulty", tmp_path / "clean"
+    spec = "delay:step=10,rank=2,ms=300"
+    logs = _spawn_workers(
+        tmp_path, _FLEET_WORKER,
+        [(rank, faulty / "obs", spec) for rank in range(3)],
+        name="fleet_faulty", timeout=120)
+    assert all("FLEET_OK" in log for log in logs)
+    logs = _spawn_workers(
+        tmp_path, _FLEET_WORKER,
+        [(rank, clean / "obs", "-") for rank in range(3)],
+        name="fleet_clean", timeout=120)
+    assert all("FLEET_OK" in log for log in logs)
+
+    # generous thresholds: real scheduler jitter rides on ~20ms steps, and
+    # a clean trip would make the gate a coin flip — the injected stall is
+    # a ~16x ratio / ~90-sigma excursion, far above either bound
+    rules = ["--rule", "fleet.skew_ratio>5",
+             "--rule", "anomaly:step_time_ms 12"]
+    rc = obsctl.main(["fleet", str(faulty), "--replay", "--json", *rules])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["report"]["max_skew_step"] == 10
+    assert out["report"]["max_skew_ratio"] >= 5.0
+    assert {ev["rule"] for ev in out["alerts"]} == set(rules[1::2])
+    # the worst-skew record names the injected rank, across real processes
+    published = read_fleet_records(faulty / "obs" / "fleet.jsonl")
+    worst = max(published, key=lambda r: r.get("skew_ratio", 0.0))
+    assert (worst["step"], worst["slowest_rank"]) == (10, 2)
+    assert worst["step_time_ms"] >= 300.0      # carries the delay
+    rc = obsctl.main(["fleet", str(clean), "--replay", "--json", *rules])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["alerts"] == []

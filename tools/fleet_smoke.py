@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Fleet straggler smoke: 3 CPU training processes, rank 2 delay-poisoned,
+`obsctl fleet --replay` must name it — the `tools/run_tier1.sh --fleet` lane.
+
+Spawns three real `Trainer` workers (gloo CPU collectives, obs=basic so
+heartbeat step times are host-side windows — async dispatch keeps the
+non-delayed ranks fast and the attribution clean), injects a composed
+``delay:`` schedule that stalls rank 2 by 300ms at steps 14/16/18, and
+verdicts the fleet layer end to end:
+
+- ``obsctl fleet --replay`` over the faulty run exits 1 with BOTH rule
+  grammars tripping (``fleet.skew_ratio>3`` and the self-baselining
+  ``anomaly:step_time_ms 12``), and the worst-skew record names rank 2;
+- the same command over a clean twin — same rules, same thresholds —
+  exits 0;
+- the published ``fleet.jsonl`` re-reads under the schema check.
+
+Archives ``artifacts/fleet_report.json`` (the faulty run's fleet summary
++ the verdict). Exit 0 on a clean gate, 1 on any violated check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))  # the driver imports the schema reader
+
+RULES = ["--rule", "fleet.skew_ratio>3",
+         "--rule", "anomaly:step_time_ms 12"]
+
+_WORKER = r"""
+import os, sys
+rank = int(sys.argv[1]); port = sys.argv[2]; ckpt = sys.argv[3]
+fault = sys.argv[4]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tpu_dp.config import Config
+from tpu_dp.train.trainer import Trainer
+
+cfg = Config()
+cfg.data.dataset = "synthetic"
+cfg.data.synthetic_train_size = 144
+cfg.data.synthetic_test_size = 16
+cfg.data.batch_size = 4
+cfg.train.epochs = 2
+cfg.train.log_every = 100
+cfg.train.eval_at_end = False
+cfg.train.steps_per_call = 1
+cfg.train.ckpt_dir = ckpt
+cfg.train.ckpt_async = False
+cfg.train.obs = "basic"
+if fault != "-":
+    cfg.resilience.fault = fault
+cfg.parallel.coordinator_address = f"127.0.0.1:{port}"
+cfg.parallel.num_processes = 3
+cfg.parallel.process_id = rank
+Trainer(cfg).fit()
+"""
+
+
+def _run_world(tmp: Path, name: str, fault: str) -> tuple[Path, list[str]]:
+    """One 3-process training run; returns (ckpt dir, failure list)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    script = tmp / f"{name}_worker.py"
+    script.write_text(_WORKER)
+    ckpt = tmp / name
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    env.pop("TPU_DP_FAULT", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r), port, str(ckpt), fault],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for r in range(3)
+    ]
+    logs, failures = [], []
+    try:
+        for p in procs:
+            logs.append(p.communicate(timeout=300)[0].decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        failures.append(f"{name}: training timed out")
+        logs += [p.communicate()[0].decode() for p in procs[len(logs):]]
+    for r, p in enumerate(procs):
+        if p.returncode != 0:
+            failures.append(f"{name} rank {r}: exit {p.returncode}")
+    if failures:
+        for r, log in enumerate(logs):
+            print(f"--- {name} rank {r}\n{log[-2000:]}", file=sys.stderr)
+    return ckpt, failures
+
+
+def _fleet(run_dir: Path) -> tuple[int, dict]:
+    cmd = [sys.executable, "-m", "tpu_dp.obs", "fleet", str(run_dir),
+           "--replay", "--json", *RULES]
+    proc = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                          env=dict(os.environ, PYTHONPATH=str(REPO)))
+    try:
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (IndexError, ValueError):
+        payload = {}
+    return proc.returncode, payload
+
+
+def main() -> int:
+    art = REPO / "artifacts"
+    art.mkdir(parents=True, exist_ok=True)
+    tmp = Path(tempfile.mkdtemp(prefix="tpu_dp_fleet_smoke."))
+    t0 = time.time()
+    fault = ";".join(f"delay:step={s},rank=2,ms=300" for s in (14, 16, 18))
+
+    ck_faulty, failures = _run_world(tmp, "faulty", fault)
+    ck_clean, f2 = _run_world(tmp, "clean", "-")
+    failures += f2
+
+    faulty_rc, faulty_out = (2, {})
+    clean_rc, clean_out = (2, {})
+    if not failures:
+        faulty_rc, faulty_out = _fleet(ck_faulty)
+        clean_rc, clean_out = _fleet(ck_clean)
+        if faulty_rc != 1:
+            failures.append(
+                f"faulty run: obsctl fleet exit {faulty_rc} != 1")
+        tripped = {ev.get("rule") for ev in faulty_out.get("alerts", [])}
+        if tripped != set(RULES[1::2]):
+            failures.append(f"faulty run: rules tripped {sorted(tripped)} "
+                            f"!= both of {RULES[1::2]}")
+        recs = []
+        stream = ck_faulty / "obs" / "fleet.jsonl"
+        if stream.exists():
+            from tpu_dp.obs.fleet import read_fleet_records
+
+            recs = read_fleet_records(stream)   # schema check is the point
+        spikes = [r for r in recs if r.get("kind") == "fleet_step"
+                  and r.get("skew_ratio", 0.0) >= 3.0]
+        if not spikes:
+            failures.append("faulty run: no >=3x skew record published")
+        elif not all(r["slowest_rank"] == 2 for r in spikes):
+            failures.append(
+                f"mis-attributed: spike slowest_ranks "
+                f"{sorted({r['slowest_rank'] for r in spikes})} != {{2}}")
+        elif not {r["step"] for r in spikes} <= {14, 16, 18}:
+            failures.append(f"spikes at {sorted(r['step'] for r in spikes)}"
+                            f" not within the injected steps {{14, 16, 18}}")
+        if clean_rc != 0:
+            failures.append(f"clean twin: obsctl fleet exit {clean_rc} != 0"
+                            f" (alerts: {clean_out.get('alerts')})")
+
+    report = {
+        "ok": not failures,
+        "failures": failures,
+        "wall_s": round(time.time() - t0, 1),
+        "rules": RULES[1::2],
+        "faulty": {"exit": faulty_rc, "report": faulty_out.get("report"),
+                   "alerts": faulty_out.get("alerts")},
+        "clean": {"exit": clean_rc, "report": clean_out.get("report")},
+    }
+    (art / "fleet_report.json").write_text(json.dumps(report, indent=2)
+                                           + "\n")
+    print(f"fleet smoke: {'OK' if not failures else 'FAIL'} "
+          f"({report['wall_s']}s) — artifacts/fleet_report.json")
+    if failures:
+        for f in failures:
+            print(f"  FAIL: {f}", file=sys.stderr)
+        return 1
+    shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -167,6 +167,26 @@
 #                                    # acceptance trio (bitrot-before-
 #                                    # rollback, SDC-during-grow,
 #                                    # preempt-mid-rollback-regroup).
+#   tools/run_tier1.sh --fleet       # fleet-telemetry lane: the straggler
+#                                    # smoke — 3 real CPU training
+#                                    # processes with rank 2 delay-poisoned
+#                                    # at steps 14/16/18, then
+#                                    # `obsctl fleet --replay` over the
+#                                    # artifacts alone must exit 1 with
+#                                    # BOTH rule grammars tripping (the
+#                                    # threshold rule fleet.skew_ratio>3
+#                                    # and the self-baselining
+#                                    # anomaly:step_time_ms 12) and every
+#                                    # >=3x skew record naming rank 2 at
+#                                    # an injected step; the clean twin
+#                                    # under the same rules must exit 0,
+#                                    # and the published fleet.jsonl must
+#                                    # re-read under the schema check.
+#                                    # Archives artifacts/
+#                                    # fleet_report.json, then the
+#                                    # -m fleet tests (shared tail,
+#                                    # stream tailer, skew/anomaly math,
+#                                    # elastic alignment, obsctl fleet).
 #   tools/run_tier1.sh --serve       # serving lane: a 200-request mixed-
 #                                    # size synthetic load through the full
 #                                    # queue → batcher → compiled-forward
@@ -905,6 +925,17 @@ PY
     rm -rf "$SMOKE"
     echo "serve-elastic smoke: artifacts/serve_elastic_report.json + serve_elastic_timeline.json"
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serve \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "--fleet" ]; then
+    # The smoke is its own verdict (exit 1 when either rule fails to trip
+    # on the poisoned run, the attribution names the wrong rank, or the
+    # clean twin alerts); the archived report is the CI record of the
+    # skew numbers both runs produced.
+    mkdir -p artifacts
+    env JAX_PLATFORMS=cpu python tools/fleet_smoke.py || exit $?
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fleet \
         -p no:cacheprovider
 fi
 

@@ -9,51 +9,59 @@ checkpointing with resume, and `jax.distributed.initialize` bootstrap in place
 of a launcher. Single-chip and N-chip runs are the same code path with
 different mesh shapes — erasing the single/DDP script fork that structures the
 reference (`/root/reference/cifar_example.py` vs `cifar_example_ddp.py`).
+
+Submodules and the top-level conveniences resolve lazily (PEP 562): the
+forensic CLIs (`python -m tpu_dp.obs`, `python -m tpu_dp.analysis`) and
+every test that shells out to them must not pay the multi-second JAX
+import for artifact reads that never touch a device. `import tpu_dp`
+stays cheap; `tpu_dp.train`, `from tpu_dp import Config`, etc. import
+exactly what they name on first access.
 """
 
-from tpu_dp import (
-    config,
-    data,
-    metrics,
-    models,
-    obs,
-    ops,
-    parallel,
-    resilience,
-    serve,
-    train,
-    utils,
-)
-from tpu_dp.checkpoint import (
-    CheckpointManager,
-    load_checkpoint,
-    load_params_only,
-    save_checkpoint,
-)
-from tpu_dp.config import Config
-from tpu_dp.parallel import dist
-from tpu_dp.train.state import TrainState
+import importlib
 
 __version__ = "0.1.0"
 
-__all__ = [
-    "CheckpointManager",
-    "Config",
-    "TrainState",
+_SUBMODULES = (
+    "analysis",
+    "chaos",
     "checkpoint",
     "config",
     "data",
-    "dist",
-    "load_checkpoint",
-    "load_params_only",
     "metrics",
     "models",
     "obs",
     "ops",
     "parallel",
     "resilience",
-    "save_checkpoint",
     "serve",
     "train",
+    "tune",
     "utils",
-]
+)
+
+# convenience name -> (module, attribute)
+_ATTRS = {
+    "CheckpointManager": ("tpu_dp.checkpoint", "CheckpointManager"),
+    "load_checkpoint": ("tpu_dp.checkpoint", "load_checkpoint"),
+    "load_params_only": ("tpu_dp.checkpoint", "load_params_only"),
+    "save_checkpoint": ("tpu_dp.checkpoint", "save_checkpoint"),
+    "Config": ("tpu_dp.config", "Config"),
+    "dist": ("tpu_dp.parallel", "dist"),
+    "TrainState": ("tpu_dp.train.state", "TrainState"),
+}
+
+__all__ = sorted({*_SUBMODULES, *_ATTRS})
+
+
+def __getattr__(name):
+    if name in _ATTRS:
+        module, attr = _ATTRS[name]
+        return getattr(importlib.import_module(module), attr)
+    if name in _SUBMODULES:
+        return importlib.import_module(f"tpu_dp.{name}")
+    raise AttributeError(f"module 'tpu_dp' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted({*globals(), *__all__})

@@ -147,6 +147,18 @@ METRICS: dict[str, str] = {
     "obs.step_time_ms": "smoothed step time (gauge, ms)",
     "obs.goodput": "examples/s across the slice (gauge)",
     "obs.mfu": "model FLOPs utilization (gauge)",
+    # fleet aggregation (obs/fleet.py — derived cross-rank signals)
+    "fleet.step_skew_ms": "max-min step-boundary arrival skew (gauge, ms)",
+    "fleet.skew_ratio": "slowest rank vs leave-one-out median (gauge)",
+    "fleet.slowest_rank": "rank currently setting the step clock (gauge)",
+    "fleet.slowest_streak": "consecutive steps same rank slowest (gauge)",
+    "fleet.step_time_p50_ms": "fleet step-clock p50 over window (gauge)",
+    "fleet.step_time_p95_ms": "fleet step-clock p95 over window (gauge)",
+    "fleet.goodput": "fleet-wide goodput re-export (gauge)",
+    "fleet.mfu": "fleet-wide MFU re-export (gauge)",
+    "fleet.queue_depth": "serve queue depth across the tier (gauge)",
+    "fleet.attainment": "worst per-class SLO attainment (gauge)",
+    "fleet.publish_errors": "fleet stream publishes swallowed",
     # quantized-collective codec (parallel/compress.py)
     "quant.overflow": "int8 blocks clipped at the absmax scale",
     "quant.clip_blocks": "blocks whose scale clipped the payload",

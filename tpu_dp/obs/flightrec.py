@@ -150,6 +150,7 @@ KINDS: dict[str, str] = {
     "profile_stop": "profiler capture stopped",
     "dump_request": "hang-dump sentinel honored; ring dumped mid-run",
     "alert": "obsctl-synthesized alert from signal thresholds",
+    "fleet_skew": "obsctl-synthesized cross-rank skew spike (fleet stream)",
 }
 
 

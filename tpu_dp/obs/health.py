@@ -97,9 +97,15 @@ class HeartbeatWriter:
     """
 
     def __init__(self, run_dir: str | os.PathLike, rank: int,
-                 every_steps: int = 1):
+                 every_steps: int = 1, me: int = 0):
         self.rank = int(rank)
         self.every_steps = max(1, int(every_steps))
+        # Membership epoch stamp: the boundary the fleet aggregator
+        # aligns on (tpu_dp/obs/fleet.py). Re-homed post-regroup writers
+        # stamp their epoch into every record so cross-rank skew is only
+        # ever computed within ONE world — the me<E>/ directory name
+        # stays the fallback for pre-stamp streams.
+        self.me = int(me)
         self.path = Path(run_dir) / f"heartbeat_r{self.rank:05d}.jsonl"
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._f = open(self.path, "a", encoding="utf-8")
@@ -126,6 +132,8 @@ class HeartbeatWriter:
             # highest-generation record per (rank, step) instead of
             # double-counting the rolled-back pass.
             rec["gen"] = self.generation
+        if self.me:
+            rec["me"] = self.me
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
         return True

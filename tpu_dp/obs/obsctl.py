@@ -28,9 +28,18 @@ work never double-counts — into:
   heartbeats of a running (or, with ``--replay``, finished) run and
   evaluates declarative alert rules (``--rule 'mfu<0.9*baseline'``,
   ``--rule 'exposed_comm_ms>5'``, goodput, overflow rate, straggler
-  ratio, stale heartbeats), emitting timeline-compatible alert events
-  and exit-coding 1 on any trip / 2 when no rule ever saw data — the
-  same semantics the MFU diff gate uses.
+  ratio, stale heartbeats, fleet signals, self-baselining
+  ``anomaly:SIGNAL K`` rules, and ``--profile tuned.json``-derived
+  bounds), emitting timeline-compatible alert events and exit-coding 1
+  on any trip / 2 when no rule ever saw data — the same semantics the
+  MFU diff gate uses;
+- ``fleet``       — the cross-rank surface (tpu_dp/obs/fleet.py): tails
+  every rank's heartbeat/metrics/serve streams concurrently, aligns per
+  (membership epoch, generation, step), and publishes derived fleet
+  signals (``fleet.step_skew_ms``, ``fleet.skew_ratio`` + slowest-rank
+  attribution with streaks, fleet p50/p95, serve queue/attainment
+  rollups) to a schema-versioned ``obs/fleet.jsonl`` + promfile —
+  with the same rule engine and exit codes as ``watch``.
 
 Run it as ``python -m tpu_dp.obs <cmd> <run_dir>`` or
 ``tools/obsctl.py``; ``run_dir`` is the training run's checkpoint root
@@ -52,8 +61,19 @@ from datetime import datetime, timezone
 from pathlib import Path
 
 from tpu_dp.obs import flightrec
+from tpu_dp.obs.fleet import (
+    FLEET_KINDS,
+    FLEET_SCHEMA,
+    FLEET_SIGNALS,
+    FleetAggregator,
+    FleetPublisher,
+    discover_streams,
+    fleet_signals,
+    summarize as fleet_summarize,
+)
 from tpu_dp.obs.health import HealthMonitor
 from tpu_dp.obs.spans import percentile
+from tpu_dp.obs.tail import JsonlTail, StreamTailer, read_jsonl
 
 #: quarantine-log kinds → the metrics-stream event names, so the same
 #: finding arriving via both files deduplicates instead of double-telling.
@@ -84,6 +104,10 @@ MARKER_KINDS = (
     # args carry the trace path and step range, so merge-trace links
     # them; watch-rule trips render next to what they fired on.
     "profile_start", "profile_stop", "comm_profile", "alert",
+    # fleet-stream skew spikes (tpu_dp/obs/fleet.py): a step whose
+    # skew_ratio crossed the spike threshold renders next to the guard /
+    # elastic events it usually precedes.
+    "fleet_skew",
 )
 
 #: Event kinds describing one REPLICATED decision that reaches the
@@ -162,6 +186,7 @@ class RunArtifacts:
             else self.run_dir / "metrics.jsonl"
         )
         self.obs_dir = self.run_dir / "obs"
+        self.fleet_path = self.obs_dir / "fleet.jsonl"
         self.quarantine_path = self.run_dir / "quarantine.jsonl"
         self.membership_dir = self.run_dir / "membership"
         self.alerts_path = self.run_dir / "alerts.jsonl"
@@ -194,6 +219,29 @@ class RunArtifacts:
     def alerts(self) -> list[dict]:
         """Alert events an `obsctl watch --alerts-out` run recorded."""
         return _read_jsonl(self.alerts_path)
+
+    def fleet_records(self) -> list[dict]:
+        """The published fleet stream (`obsctl fleet`), schema-checked.
+
+        RECORDS of an unknown schema are SKIPPED with a warning here —
+        the timeline is forensic and must render what it can (a stream
+        appended to by a newer build still has readable records) — while
+        `read_fleet_records` callers that certify numbers (fleet replay,
+        reports) get the hard refusal."""
+        if not self.fleet_path.exists():
+            return []
+        out: list[dict] = []
+        skipped = 0
+        for rec in read_jsonl(self.fleet_path):
+            if rec.get("schema") == FLEET_SCHEMA:
+                out.append(rec)
+            else:
+                skipped += 1
+        if skipped:
+            print(f"obsctl: skipped {skipped} fleet record(s) in "
+                  f"{self.fleet_path} with unknown schema (this build "
+                  f"reads {FLEET_SCHEMA!r})", file=sys.stderr)
+        return out
 
     def comm_report(self) -> dict | None:
         """The newest archived comm-attribution window, when one exists
@@ -394,6 +442,16 @@ def build_timeline(art: RunArtifacts, include_steps: bool = False) -> dict:
                     for k in ("rule", "signal", "value", "bound")
                     if rec.get(k) is not None})
 
+    # -- fleet stream (skew spikes published by `obsctl fleet`) ---------
+    for rec in art.fleet_records():
+        if rec.get("kind") == "fleet_step" and rec.get("spike"):
+            add("fleet_skew", _parse_ts(rec.get("ts")), "fleet",
+                step=rec.get("step"), rank=rec.get("slowest_rank"),
+                detail={"skew_ratio": rec.get("skew_ratio"),
+                        "step_skew_ms": rec.get("step_skew_ms"),
+                        "slowest_streak": rec.get("slowest_streak"),
+                        "me": rec.get("me")})
+
     # -- join requests + refusals (the admission story) -----------------
     for rec in art.join_requests():
         add("elastic_join_request", _parse_ts(rec.get("ts")), "membership",
@@ -483,6 +541,7 @@ def build_timeline(art: RunArtifacts, include_steps: bool = False) -> dict:
             "membership": art.membership_dir.is_dir(),
             "flightrec_dumps": len(dumps),
             "heartbeat_dirs": len(art.heartbeat_dirs()),
+            "fleet": art.fleet_path.exists(),
         },
         "steps": {
             "distinct": len(best),
@@ -769,6 +828,12 @@ def diff_verdict(run: dict, base: dict, tolerance: float) -> dict:
 _RULE_RE = re.compile(
     r"^\s*([A-Za-z_][\w.]*)\s*(<=|>=|<|>)\s*(.+?)\s*$"
 )
+#: self-baselining rule text: ``anomaly:SIGNAL K`` — trips when the
+#: signal lands K robust deviations (rolling median/MAD) outside its own
+#: trailing history; no --baseline file needed.
+_ANOMALY_RE = re.compile(
+    r"^\s*anomaly:([A-Za-z_][\w.]*)\s+([0-9]*\.?[0-9]+)\s*$"
+)
 _OPS = {
     "<": lambda v, b: v < b,
     ">": lambda v, b: v > b,
@@ -783,15 +848,49 @@ WATCH_SIGNALS = (
     "comm_ms", "exposed_comm_ms", "overlap_frac",
     "quant_overflow_per_step", "quant_clip_blocks_per_step",
     "straggler_ratio", "heartbeat_age_s",
+    # fleet signals (tpu_dp/obs/fleet.py): first-class rule targets —
+    # `--rule 'fleet.skew_ratio>1.5'` exit-codes like any stream signal.
+    # They arrive via fleet records (`obsctl fleet --rule`, or watch over
+    # a published fleet.jsonl).
+    *FLEET_SIGNALS,
 )
 
 
 class WatchRule:
     """One parsed ``--rule``: a signal, a comparison, and a bound that is
     either a constant or a factor of the baseline's value of the same
-    signal (``mfu<0.9*baseline``)."""
+    signal (``mfu<0.9*baseline``) — or, with ``kind == "anomaly"``, a
+    self-baselining rule (``anomaly:step_time_ms 4``) that trips when
+    the signal lands that many robust deviations (rolling median/MAD)
+    outside its own trailing history."""
 
     def __init__(self, text: str):
+        self.kind = "threshold"
+        self.const: float | None = None
+        self.factor: float | None = None
+        self.op: str | None = None
+        self.deviations: float = 0.0
+        am = _ANOMALY_RE.match(text)
+        if am is not None:
+            self.kind = "anomaly"
+            self.text = text.strip()
+            self.signal = am.group(1)
+            if self.signal not in WATCH_SIGNALS:
+                raise ValueError(
+                    f"rule {text!r} references unknown signal "
+                    f"{self.signal!r} (known: {', '.join(WATCH_SIGNALS)})"
+                )
+            self.deviations = float(am.group(2))
+            if self.deviations <= 0:
+                raise ValueError(
+                    f"rule {text!r}: the deviation count must be > 0"
+                )
+            return
+        if text.strip().startswith("anomaly:"):
+            raise ValueError(
+                f"rule {text!r} is not 'anomaly:SIGNAL K' "
+                f"(e.g. 'anomaly:step_time_ms 4')"
+            )
         m = _RULE_RE.match(text)
         if m is None:
             raise ValueError(
@@ -807,8 +906,6 @@ class WatchRule:
                 f"rule {text!r} references unknown signal "
                 f"{self.signal!r} (known: {', '.join(WATCH_SIGNALS)})"
             )
-        self.const: float | None = None
-        self.factor: float | None = None
         b = bound.replace(" ", "")
         if b == "baseline":
             self.factor = 1.0
@@ -838,7 +935,13 @@ def stream_signals(rec: dict) -> dict:
     contributes no ``mfu`` sample, a run that never profiled a comm
     window never produces ``exposed_comm_ms`` — a rule on a signal the
     run does not publish simply never evaluates (and `watch` exits 2
-    when NO rule ever saw data, the diff gate's refuse-to-certify)."""
+    when NO rule ever saw data, the diff gate's refuse-to-certify).
+
+    Fleet records (`tpu_dp.obs.fleet`) map through `fleet_signals`:
+    ``fleet.*`` targets plus the fleet step clock as ``step_time_ms``,
+    so anomaly rules on step time work over the fleet stream too."""
+    if rec.get("kind") in FLEET_KINDS:
+        return fleet_signals(rec)
     sig: dict[str, float] = {}
     for key in ("mfu", "goodput"):
         if isinstance(rec.get(key), (int, float)):
@@ -900,45 +1003,15 @@ def end_signals(art: RunArtifacts, now: float | None = None) -> dict:
     return sig
 
 
-class _MetricsTail:
-    """Incremental reader over a live metrics.jsonl: remembers the byte
-    offset of the last COMPLETE line so each poll tick parses only what
-    was appended since (a whole-file re-parse per tick costs quadratic
-    IO over a long watch). A partial trailing line (the sink mid-append)
-    is left for the next tick; a shrunken file (truncate/rotate) resets
-    to the top. Same torn-line tolerance as `_read_jsonl`."""
-
-    def __init__(self, path: Path):
-        self.path = Path(path)
-        self._offset = 0
-
-    def poll(self) -> list[dict]:
-        try:
-            size = self.path.stat().st_size
-        except OSError:
-            return []
-        if size < self._offset:
-            self._offset = 0
-        if size == self._offset:
-            return []
-        out: list[dict] = []
-        with open(self.path, "rb") as f:
-            f.seek(self._offset)
-            for line in f:
-                if not line.endswith(b"\n"):
-                    break
-                self._offset += len(line)
-                try:
-                    rec = json.loads(line.decode("utf-8"))
-                except ValueError:
-                    continue
-                if isinstance(rec, dict):
-                    out.append(rec)
-        return out
+#: the byte-offset incremental reader now lives in `tpu_dp.obs.tail`
+#: (shared with the fleet aggregator); the old private name stays an
+#: alias so downstream imports keep resolving.
+_MetricsTail = JsonlTail
 
 
 def _alert_event(rule: WatchRule, value: float, bound: float,
-                 step, ts: float | None) -> dict:
+                 step, ts: float | None,
+                 extra: dict | None = None) -> dict:
     ts = float(ts) if ts is not None else datetime.now(
         timezone.utc).timestamp()
     ev = {"ts": ts, "iso": _iso(ts), "kind": "alert", "source": "watch",
@@ -946,7 +1019,41 @@ def _alert_event(rule: WatchRule, value: float, bound: float,
           "value": round(float(value), 6), "bound": round(float(bound), 6)}
     if step is not None:
         ev["step"] = step
+    if extra:
+        ev.update(extra)
     return ev
+
+
+def profile_rules(path: Path, tolerance: float = 0.2) -> list[WatchRule]:
+    """Watch rules derived from a tuned profile's provenance claims.
+
+    The ROADMAP item-3 follow-up docs/TUNE.md promises: a deployed
+    profile's measured numbers become live bounds, so `obsctl watch
+    --profile tuned.json` re-validates the profile continuously. Claims
+    the live stream cannot observe (``img_per_sec_per_chip`` has no
+    stream twin — `tune validate` certifies it offline) derive no rule;
+    lower-is-worse claims bound from below, higher-is-worse from above,
+    with ``tolerance`` relative slack like `obsctl diff`. Raises
+    `tpu_dp.tune.profile.ProfileError` on a bad profile — a watch armed
+    from a file that is not a tuned.json must refuse, not silently
+    watch nothing."""
+    from tpu_dp.tune.profile import load_profile
+
+    claims = load_profile(path).get("claims") or {}
+    texts: list[str] = []
+    for sig in ("mfu", "goodput", "overlap_frac"):
+        v = claims.get(sig)
+        if isinstance(v, (int, float)) and v > 0:
+            texts.append(f"{sig}<{round((1 - tolerance) * v, 6)}")
+    for sig in ("comm_ms", "exposed_comm_ms"):
+        v = claims.get(sig)
+        if isinstance(v, (int, float)) and v > 0:
+            texts.append(f"{sig}>{round((1 + tolerance) * v, 6)}")
+    v = claims.get("p95_ms")
+    if isinstance(v, (int, float)) and v > 0:
+        # the claims' p95 step latency gates the live step-time gauge
+        texts.append(f"step_time_ms>{round((1 + tolerance) * v, 6)}")
+    return [WatchRule(t) for t in texts]
 
 
 class WatchEngine:
@@ -955,7 +1062,24 @@ class WatchEngine:
     One instance per `cmd_watch` run; `observe_record` feeds stream
     records in order, `observe_state` the end-state signals (repeatable
     — an end-state rule trips at most once). ``evaluated`` tracks which
-    rules ever saw data, for the exit-2 refuse-to-certify verdict."""
+    rules ever saw data, for the exit-2 refuse-to-certify verdict.
+
+    Anomaly rules keep a rolling window per rule: the incoming value is
+    scored against the window's median/MAD BEFORE joining it (a spike
+    must not baseline itself), and only counts as evaluated once the
+    window holds ``ANOMALY_MIN_POINTS`` — an anomaly rule that never
+    accumulated history exit-2s like any rule that never saw data."""
+
+    #: trailing history per anomaly rule; long enough to smooth one-off
+    #: jitter, short enough to track a drifting run.
+    ANOMALY_WINDOW = 32
+    #: minimum history before an anomaly rule scores anything — a median
+    #: of two points is not a baseline.
+    ANOMALY_MIN_POINTS = 8
+    #: sigma floor as a fraction of |median|: near-constant signals have
+    #: MAD ~ 0, and without the floor any scheduler-jitter wiggle would
+    #: score as infinitely anomalous.
+    ANOMALY_REL_FLOOR = 0.05
 
     def __init__(self, rules: list[WatchRule], baseline: dict | None):
         self.rules = rules
@@ -963,11 +1087,51 @@ class WatchEngine:
         self.alerts: list[dict] = []
         self.evaluated: set[str] = set()
         self._state_tripped: set[str] = set()
+        from collections import deque as _deque
+
+        self._windows: dict[str, object] = {}
+        self._deque = _deque
+
+    def _check_anomaly(self, rule: WatchRule, value: float,
+                       step, ts) -> None:
+        win = self._windows.get(rule.text)
+        if win is None:
+            win = self._windows[rule.text] = self._deque(
+                maxlen=self.ANOMALY_WINDOW)
+        try:
+            if len(win) < self.ANOMALY_MIN_POINTS:
+                return
+            ordered = sorted(win)
+            med = percentile(ordered, 50)
+            mad = percentile(sorted(abs(v - med) for v in win), 50)
+            # 1.4826 x MAD estimates the std dev of normal data — K
+            # "robust deviations" then reads like K sigmas.
+            sigma = max(1.4826 * mad,
+                        self.ANOMALY_REL_FLOOR * abs(med), 1e-9)
+            score = abs(value - med) / sigma
+            self.evaluated.add(rule.text)
+            if score > rule.deviations:
+                bound = med + (sigma * rule.deviations
+                               if value >= med else
+                               -sigma * rule.deviations)
+                self.alerts.append(_alert_event(
+                    rule, value, bound, step, ts,
+                    extra={"score": round(score, 3),
+                           "median": round(med, 6),
+                           "window": len(win)}))
+        finally:
+            # the value always joins the history — an adapting baseline
+            # is the point; persistent regressions are threshold rules'
+            # and streak counters' business
+            win.append(float(value))
 
     def _check(self, rule: WatchRule, sig: dict, step, ts,
                once: bool = False) -> None:
         value = sig.get(rule.signal)
         if value is None:
+            return
+        if rule.kind == "anomaly":
+            self._check_anomaly(rule, float(value), step, ts)
             return
         bound = rule.bound(self.baseline)
         if bound is None:
@@ -1027,6 +1191,17 @@ def build_merged_trace(art: RunArtifacts) -> dict:
             name = f"rank {rank}" + (f" (me{me_epoch})" if me_epoch else "")
             traces.append(to_trace_events(recs, rank=pid,
                                           process_name=name))
+    # the fleet stream's skew renders as counter tracks — the cross-rank
+    # signal lines up under the per-rank step tracks it was derived from
+    points = [
+        {"ts": rec["ts"],
+         "counters": {"fleet.step_skew_ms": rec.get("step_skew_ms"),
+                      "fleet.skew_ratio": rec.get("skew_ratio")}}
+        for rec in art.fleet_records() if rec.get("kind") == "fleet_step"
+    ]
+    if points:
+        traces.append(to_trace_events(
+            [], rank=999_000, counter_points=points, process_name="fleet"))
     markers = []
     for ev in build_timeline(art)["events"]:
         if ev["kind"] in MARKER_KINDS:
@@ -1202,8 +1377,17 @@ def cmd_watch(args) -> int:
     except ValueError as e:
         print(f"obsctl watch: {e}", file=sys.stderr)
         return 2
+    if getattr(args, "profile", None):
+        from tpu_dp.tune.profile import ProfileError
+
+        try:
+            rules.extend(profile_rules(Path(args.profile),
+                                       tolerance=args.profile_tolerance))
+        except ProfileError as e:
+            print(f"obsctl watch: {e}", file=sys.stderr)
+            return 2
     if not rules:
-        print("obsctl watch: at least one --rule required "
+        print("obsctl watch: at least one --rule (or --profile) required "
               "(e.g. --rule 'mfu<0.9*baseline')", file=sys.stderr)
         return 2
     baseline = None
@@ -1216,9 +1400,18 @@ def cmd_watch(args) -> int:
         return 2
     art = RunArtifacts(args.run_dir, metrics_path=args.metrics)
     eng = WatchEngine(rules, baseline)
+    # fleet.* rules need fleet records: the published stream when one
+    # exists, else (replay only) a fresh aggregation over the raw
+    # artifacts — a fleet rule must be evaluable from artifacts alone.
+    needs_fleet = any(r.signal.startswith("fleet.") for r in rules)
 
     if args.replay:
         for rec in sweep_rollback_generations(art.metrics()):
+            eng.observe_record(rec)
+        fleet_recs = art.fleet_records()
+        if not fleet_recs and needs_fleet:
+            fleet_recs = FleetAggregator(art.run_dir).replay()
+        for rec in fleet_recs:
             eng.observe_record(rec)
         eng.observe_state(end_signals(art))
     else:
@@ -1227,13 +1420,19 @@ def cmd_watch(args) -> int:
         # only where it is DATA — the `now`/`ts` stamps compared against
         # artifact mtimes and recorded in alerts.
         deadline = _time.monotonic() + max(0.0, args.for_s)
-        tail = _MetricsTail(art.metrics_path)
+        tail = JsonlTail(art.metrics_path)
+        fleet_tail = JsonlTail(art.fleet_path)
         while True:
             # Raw append-order tail (no generation sweep): live watching
             # reads the stream as it grows; a rollback's replayed records
             # are new observations, exactly what a pager should see.
             for rec in tail.poll():
                 eng.observe_record(rec)
+            for rec in fleet_tail.poll():
+                # live fleet records feed rules only on a known schema —
+                # a future layout must not be half-interpreted
+                if rec.get("schema") == FLEET_SCHEMA:
+                    eng.observe_record(rec)
             eng.observe_state(end_signals(art, now=_time.time()),
                               ts=_time.time())
             if _time.monotonic() >= deadline:
@@ -1265,6 +1464,133 @@ def cmd_watch(args) -> int:
               + ", ".join(WATCH_SIGNALS) + ")", file=sys.stderr)
         return 2
     return 1 if eng.alerts else 0
+
+
+def cmd_fleet(args) -> int:
+    """Aggregate per-rank streams into the fleet stream; the live
+    cross-rank surface.
+
+    Tails every rank's heartbeat stream, the metrics sink, and the
+    serve router/replica streams concurrently (`StreamTailer`), aligns
+    per (membership epoch, generation, step), and publishes derived
+    fleet records to ``<obs>/fleet.jsonl`` (+ promfile gauges with
+    ``--prom``). ``--replay`` aggregates the finished artifacts in one
+    pass — the CI mode: a straggler-injected run must exit 1 naming the
+    injected rank under a ``--rule``, the clean twin 0. Rules use the
+    full watch grammar (fleet signals, anomaly rules) and exit-code
+    identically: 0 clean, 1 any trip, 2 no data / no rule saw data.
+    """
+    import time as _time
+
+    try:
+        rules = [WatchRule(r) for r in (args.rule or [])]
+    except ValueError as e:
+        print(f"obsctl fleet: {e}", file=sys.stderr)
+        return 2
+    baseline = None
+    if args.baseline:
+        baseline = load_baseline(Path(args.baseline))
+    missing = [r.text for r in rules if r.needs_baseline and baseline is None]
+    if missing:
+        print(f"obsctl fleet: rules {missing} reference 'baseline' but no "
+              f"--baseline was given", file=sys.stderr)
+        return 2
+    art = RunArtifacts(args.run_dir, metrics_path=args.metrics)
+    out_path = Path(args.out) if args.out else art.fleet_path
+    agg = FleetAggregator(
+        art.run_dir, min_step_ms=args.min_step_ms,
+        spike_ratio=args.spike_ratio, window=args.window,
+        expected_world=args.world or None,
+    )
+    pub = FleetPublisher(out_path, prom_path=args.prom)
+    eng = WatchEngine(rules, baseline)
+    records: list[dict] = []
+
+    def handle(recs: list[dict]) -> None:
+        pub.publish(recs)
+        records.extend(recs)
+        for rec in recs:
+            eng.observe_record(rec)
+
+    if args.replay:
+        handle(agg.replay())
+    else:
+        # Live: a background tailer polls every discovered stream while
+        # this loop drains, aggregates, and publishes. The duration
+        # budget is monotonic (DP403/DP402) — wall-clock stays only
+        # where it is data (record ts stamps).
+        deadline = _time.monotonic() + max(0.0, args.for_s)
+        tailer = StreamTailer(
+            interval_s=max(0.1, min(1.0, args.interval / 2)))
+        with tailer:
+            while True:
+                for kind, meta, path in discover_streams(art.run_dir):
+                    if tailer.add(path, (kind, meta)):
+                        agg.note_stream(kind, meta)
+                for (kind, meta), rec in tailer.drain():
+                    handle(agg.ingest(kind, meta, rec))
+                if _time.monotonic() >= deadline:
+                    break
+                _time.sleep(max(0.1, args.interval))
+        # final synchronous sweep AFTER the thread stopped (no racing
+        # tails), so --for-s 0 still aggregates the current state once
+        for kind, meta, path in discover_streams(art.run_dir):
+            if tailer.add(path, (kind, meta)):
+                agg.note_stream(kind, meta)
+        tailer.poll_once()
+        for (kind, meta), rec in tailer.drain():
+            handle(agg.ingest(kind, meta, rec))
+        handle(agg.flush())
+
+    report = fleet_summarize(records)
+    if args.report:
+        rp = Path(args.report)
+        rp.parent.mkdir(parents=True, exist_ok=True)
+        rp.write_text(json.dumps(report, indent=2) + "\n")
+    if args.alerts_out and eng.alerts:
+        ap = Path(args.alerts_out)
+        ap.parent.mkdir(parents=True, exist_ok=True)
+        with open(ap, "a", encoding="utf-8") as f:
+            for ev in eng.alerts:
+                f.write(json.dumps(ev) + "\n")
+    if args.json:
+        print(json.dumps({
+            "report": report,
+            "published": pub.published,
+            "out": str(out_path),
+            "alerts": eng.alerts,
+            "rules": [r.text for r in rules],
+            "evaluated": sorted(eng.evaluated),
+        }))
+    else:
+        for ev in eng.alerts:
+            print(f"{ev['iso']}  ALERT {ev['rule']}  value={ev['value']} "
+                  f"bound={ev['bound']}"
+                  + (f" step={ev['step']}" if "step" in ev else ""))
+        if report.get("steps"):
+            print(f"fleet: {report['steps']} step records "
+                  f"(steps {report['first_step']}..{report['last_step']}), "
+                  f"max skew_ratio {report['max_skew_ratio']} "
+                  f"(rank {report['slowest_rank']} slowest most often, "
+                  f"streak <= {report['max_slowest_streak']}), "
+                  f"p95 {report['step_time_p95_ms']} ms, "
+                  f"{report['spikes']} spike(s) -> {out_path}")
+        else:
+            print("fleet: no alignable step records "
+                  "(need >= 2 ranks' heartbeats)")
+    if not records:
+        print("obsctl fleet: no fleet records derived — need >= 2 ranks' "
+              "heartbeat streams (train.obs=basic|full) or serve streams",
+              file=sys.stderr)
+        return 2
+    if rules:
+        if not eng.evaluated:
+            print("obsctl fleet: no rule ever saw data — cannot certify "
+                  "(known signals: " + ", ".join(WATCH_SIGNALS) + ")",
+                  file=sys.stderr)
+            return 2
+        return 1 if eng.alerts else 0
+    return 0
 
 
 def main(argv=None) -> int:
@@ -1341,7 +1667,53 @@ def main(argv=None) -> int:
     p.add_argument("--alerts-out", default=None,
                    help="append tripped alert events to this jsonl "
                         "(obsctl timeline merges <run>/alerts.jsonl)")
+    p.add_argument("--profile", default=None,
+                   help="tuned.json whose provenance claims derive watch "
+                        "rules (docs/TUNE.md: live profile re-validation)")
+    p.add_argument("--profile-tolerance", type=float, default=0.2,
+                   dest="profile_tolerance",
+                   help="relative slack on profile-derived bounds")
     p.set_defaults(fn=cmd_watch)
+
+    p = sub.add_parser(
+        "fleet",
+        help="aggregate per-rank streams into live cross-rank fleet "
+             "signals (skew attribution, fleet p50/p95, serve rollups)",
+    )
+    common(p)
+    p.add_argument("--rule", action="append", default=[],
+                   help="watch-grammar rule over fleet + stream signals, "
+                        "e.g. 'fleet.skew_ratio>1.5', "
+                        "'anomaly:step_time_ms 4' (repeatable)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline json for '*baseline' bounds")
+    p.add_argument("--replay", action="store_true",
+                   help="aggregate the finished artifacts in one pass")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="live aggregation cadence (seconds)")
+    p.add_argument("--for-s", type=float, default=0.0, dest="for_s",
+                   help="live duration; 0 = aggregate the current state "
+                        "once")
+    p.add_argument("-o", "--out", default=None,
+                   help="fleet stream path (default <run>/obs/fleet.jsonl)")
+    p.add_argument("--prom", default=None,
+                   help="also export fleet gauges to this promfile")
+    p.add_argument("--report", default=None,
+                   help="write the fleet summary report json here")
+    p.add_argument("--alerts-out", default=None,
+                   help="append tripped alert events to this jsonl")
+    p.add_argument("--spike-ratio", type=float, default=3.0,
+                   dest="spike_ratio",
+                   help="skew_ratio at which a step records as a spike "
+                        "(timeline marker)")
+    p.add_argument("--min-step-ms", type=float, default=1.0,
+                   dest="min_step_ms",
+                   help="floor on the leave-one-out median denominator")
+    p.add_argument("--window", type=int, default=64,
+                   help="rolling window for fleet p50/p95")
+    p.add_argument("--world", type=int, default=0,
+                   help="expected ranks per step (default: ranks seen)")
+    p.set_defaults(fn=cmd_fleet)
 
     args = ap.parse_args(argv)
     try:

@@ -44,6 +44,7 @@ so the load generator and its exactness audit drive both unchanged.
 
 from __future__ import annotations
 
+import json
 import signal
 import threading
 import time
@@ -193,6 +194,21 @@ class ServeCluster:
             ))
         self.num_classes = self.replicas[0].num_classes
         self.world = per * self.n_replicas
+
+        # Fleet-stream registration (tpu_dp/obs/fleet.py): the health
+        # loop appends one router record + per-replica records per tick
+        # so a fleet aggregator can derive queue depth / attainment /
+        # replica status across the tier from the files alone. Append
+        # handles are opened once (one writer per file, like heartbeats).
+        self._router_stream = None
+        self._replica_streams: dict[int, object] = {}
+        if self.obs_dir is not None:
+            self._router_stream = open(
+                self.obs_dir / "serve_router.jsonl", "a", encoding="utf-8")
+            for sid in range(self.n_replicas):
+                self._replica_streams[sid] = open(
+                    self.obs_dir / f"replica_r{sid:05d}.jsonl", "a",
+                    encoding="utf-8")
 
         self._health_thread: threading.Thread | None = None
         self._health_stop = threading.Event()
@@ -345,6 +361,49 @@ class ServeCluster:
                 r.quarantined = False
                 self._counters.gauge(f"serve.replica_health.{r.sid}", 1)
                 flightrec.record("replica_restored", replica=r.sid)
+        self._publish_fleet_streams()
+
+    def _publish_fleet_streams(self) -> None:
+        """Append one router record + per-replica records for the fleet
+        aggregator (`tpu_dp.obs.fleet.discover_streams` finds the files).
+
+        Every failure is swallowed into ``fleet.publish_errors``: this
+        runs on the health loop, which must keep quarantining wedged
+        replicas even when the obs filesystem is full.
+
+        Replica fields are read lock-free (GIL-atomic attribute loads),
+        NEVER via ``r.snapshot()``: a wedged replica holds its ``_lock``
+        across the device sync — the exact state this loop exists to
+        detect — so contending on it here would stall the tick past the
+        quarantine window. ``_books_lock`` is safe: its holds are brief
+        post-sync bookkeeping, never spanning a device call."""
+        if self._router_stream is None:
+            return
+        try:
+            now = time.time()
+            with self._books_lock:
+                classes = self.latency_book.rollup(
+                    self.class_slo_ms, self.slo_ms)
+            live = sum(1 for r in self.replicas
+                       if r.status == "running" and not r.quarantined)
+            rec = {"kind": "router", "ts": now,
+                   "queue_depth": len(self.queue),
+                   "replicas_live": live, "classes": classes}
+            self._router_stream.write(json.dumps(rec) + "\n")
+            self._router_stream.flush()
+            for r in self.replicas:
+                f = self._replica_streams.get(r.sid)
+                if f is None:
+                    continue
+                rep = {"kind": "replica", "sid": r.sid, "ts": now,
+                       "status": r.status,
+                       "batches": r._batch_index,
+                       "quarantined": r.quarantined,
+                       "model_version": r.model_version}
+                f.write(json.dumps(rep) + "\n")
+                f.flush()
+        except Exception:
+            self._counters.inc("fleet.publish_errors")
 
     def _health_loop(self) -> None:
         while not self._health_stop.wait(self.health_every_s):
@@ -479,6 +538,14 @@ class ServeCluster:
         for r in self.replicas:
             if r._hb is not None:
                 r._hb.close()
+        for f in ([self._router_stream] if self._router_stream else []) + \
+                list(self._replica_streams.values()):
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._router_stream = None
+        self._replica_streams = {}
         if self._errors and not any(
             r.status in ("running", "stopped", "left") for r in self.replicas
         ):

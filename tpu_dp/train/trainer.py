@@ -2576,6 +2576,7 @@ class Trainer:
             self.heartbeat = HeartbeatWriter(
                 run_dir, rank=self.ctx.process_index,
                 every_steps=self.cfg.obs.heartbeat_every_steps,
+                me=record.epoch,
             )
         if self.heartbeat is not None and self.ctx.process_index == 0:  # dplint: allow(DP101) host-only monitor
             self.health = HealthMonitor(
